@@ -1,0 +1,166 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Distributed core (tasks / actors / objects) with TPU chips and ICI slices as
+first-class scheduled resources, plus JAX/XLA/Pallas library layers: train,
+tune, data, serve, rllib. The capability surface mirrors the reference
+surveyed in SURVEY.md; the architecture is TPU-first throughout.
+
+Public core API (reference: python/ray/_private/worker.py — ray.init:1139,
+get:2461, put:2590, wait:2653, remote:3027).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Sequence
+
+from ray_tpu._private import worker as _worker_mod
+from ray_tpu._private.config import reset_config
+from ray_tpu._private.ids import JobID, NodeID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import CoreWorker, global_worker, set_global_worker
+from ray_tpu.actor import ActorHandle, get_actor, kill
+from ray_tpu.remote_function import remote_decorator as remote
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+_init_lock = threading.Lock()
+_node_handle = None
+
+# module alias so `ray_tpu.worker.global_worker()` works (used by ObjectRef)
+worker = _worker_mod
+
+
+def is_initialized() -> bool:
+    return _worker_mod.global_worker_or_none() is not None
+
+
+def init(
+    *,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    resources: dict[str, float] | None = None,
+    object_store_memory: int | None = None,
+    labels: dict[str, str] | None = None,
+    _system_config: dict[str, Any] | None = None,
+    ignore_reinit_error: bool = False,
+):
+    """Start a single-host cluster (store daemon + GCS + raylet) and connect
+    this process as the driver."""
+    global _node_handle
+    with _init_lock:
+        if is_initialized():
+            if ignore_reinit_error:
+                return _node_handle
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        reset_config(_system_config)
+        from ray_tpu._private.node import start_head
+
+        _node_handle = start_head(
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources,
+            labels=labels,
+            object_store_memory=object_store_memory,
+        )
+        job_id = JobID(
+            _node_handle.raylet.gcs.call("next_job_id")["job_id"]
+        )
+        core = CoreWorker(
+            mode="driver",
+            gcs_address=_node_handle.gcs_address,
+            raylet_address=_node_handle.raylet.address,
+            store_socket=_node_handle.store_socket,
+            job_id=job_id,
+            node_id=_node_handle.node_id,
+        )
+        set_global_worker(core)
+        return _node_handle
+
+
+def connect(
+    *,
+    gcs_address: str,
+    raylet_address: str,
+    store_socket: str,
+) -> None:
+    """Connect this process as a driver to an existing cluster (the
+    `ray.init(address=...)` analog)."""
+    with _init_lock:
+        if is_initialized():
+            raise RuntimeError("already connected")
+        from ray_tpu._private.rpc import RpcClient
+
+        gcs = RpcClient(gcs_address)
+        job_id = JobID(gcs.call("next_job_id")["job_id"])
+        gcs.close()
+        core = CoreWorker(
+            mode="driver",
+            gcs_address=gcs_address,
+            raylet_address=raylet_address,
+            store_socket=store_socket,
+            job_id=job_id,
+            node_id=NodeID.nil(),
+        )
+        set_global_worker(core)
+
+
+def shutdown() -> None:
+    global _node_handle
+    with _init_lock:
+        w = _worker_mod.global_worker_or_none()
+        if w is not None:
+            w.shutdown()
+            set_global_worker(None)
+        if _node_handle is not None:
+            _node_handle.shutdown()
+            _node_handle = None
+
+
+def put(value: Any) -> ObjectRef:
+    return global_worker().put(value)
+
+
+def get(refs: ObjectRef | Sequence[ObjectRef], *, timeout: float | None = None):
+    return global_worker().get(refs, timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: float | None = None,
+):
+    return global_worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def cluster_resources() -> dict[str, float]:
+    return global_worker().gcs.call("cluster_resources")["total"]
+
+
+def available_resources() -> dict[str, float]:
+    return global_worker().gcs.call("cluster_resources")["available"]
+
+
+def nodes() -> list[dict]:
+    return global_worker().gcs.call("get_nodes")["nodes"]
+
+
+__all__ = [
+    "init",
+    "shutdown",
+    "connect",
+    "is_initialized",
+    "remote",
+    "put",
+    "get",
+    "wait",
+    "kill",
+    "get_actor",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "ObjectRef",
+    "ActorHandle",
+    "exceptions",
+]

@@ -1,0 +1,94 @@
+"""Flag table for the runtime.
+
+Equivalent of the reference's RAY_CONFIG macro table
+(reference: src/ray/common/ray_config_def.h — 209 flags, overridable via
+RAY_<name> env vars and a `_system_config` dict passed at init). Here every
+flag is declared once below, overridable via ``RAY_TPU_<NAME>`` env vars or
+the ``_system_config`` dict argument to :func:`ray_tpu.init`.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class Config:
+    # --- core worker / scheduling ---
+    task_retry_delay_ms: int = 100
+    max_pending_lease_requests_per_scheduling_key: int = 10
+    worker_lease_timeout_ms: int = 10_000
+    max_direct_call_object_size: int = 100 * 1024  # inline small results in-band
+    task_rpc_inlined_bytes_limit: int = 10 * 1024 * 1024
+    # --- object store ---
+    object_store_memory_bytes: int = 512 * 1024 * 1024
+    object_store_full_delay_ms: int = 100
+    object_spilling_dir: str = ""  # default under session dir
+    min_spilling_size: int = 1 * 1024 * 1024
+    # --- raylet ---
+    num_workers_soft_limit: int = -1  # default: num_cpus
+    worker_register_timeout_s: int = 30
+    kill_idle_workers_interval_ms: int = 200
+    idle_worker_killing_time_threshold_ms: int = 1000
+    # --- GCS ---
+    gcs_heartbeat_interval_ms: int = 1000
+    health_check_failure_threshold: int = 5
+    gcs_pubsub_poll_timeout_s: int = 30
+    # --- actors ---
+    actor_creation_timeout_s: int = 60
+    max_actor_restarts_default: int = 0
+    # --- TPU topology ---
+    tpu_chips_per_host_default: int = 4
+    ici_bandwidth_gbps: float = 400.0  # advisory, used by autoscaler packing
+    # --- observability ---
+    task_events_buffer_size: int = 10_000
+    metrics_report_interval_ms: int = 2000
+    # --- testing ---
+    fake_tpu_hosts: int = 0  # >0 enables the in-process fake multi-node harness
+
+    def apply_overrides(self, system_config: dict[str, Any] | None = None) -> None:
+        for f in fields(self):
+            env_key = _ENV_PREFIX + f.name.upper()
+            if env_key in os.environ:
+                setattr(self, f.name, _parse(os.environ[env_key], f.type))
+        if system_config:
+            for key, value in system_config.items():
+                if not any(f.name == key for f in fields(self)):
+                    raise ValueError(f"Unknown system config key: {key}")
+                setattr(self, key, value)
+
+
+def _parse(raw: str, ftype: Any) -> Any:
+    ftype = str(ftype)
+    if "int" in ftype:
+        return int(raw)
+    if "float" in ftype:
+        return float(raw)
+    if "bool" in ftype:
+        return raw.lower() in ("1", "true", "yes")
+    try:
+        return json.loads(raw)
+    except (ValueError, TypeError):
+        return raw
+
+
+_config: Config | None = None
+
+
+def global_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+        _config.apply_overrides()
+    return _config
+
+
+def reset_config(system_config: dict[str, Any] | None = None) -> Config:
+    global _config
+    _config = Config()
+    _config.apply_overrides(system_config)
+    return _config

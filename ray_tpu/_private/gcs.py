@@ -1,0 +1,404 @@
+"""GCS — the global control service (cluster metadata + coordination).
+
+Equivalent of the reference's GCS server
+(reference: src/ray/gcs/gcs_server/gcs_server.h:79 composing GcsNodeManager,
+GcsActorManager (actor FT state machine, gcs_actor_manager.h:281),
+GcsPlacementGroupManager with its 2-phase scheduler
+(gcs_placement_group_scheduler.cc:884), internal KV (gcs_kv_manager.h:138),
+health checks (gcs_health_check_manager.h:39), and pubsub). Here it is one
+Python service object behind an RpcServer, storing state in process memory
+(the reference's default InMemoryStoreClient) — a Redis-like external store
+can be slotted in behind the same table dicts later.
+
+Placement groups use the same 2-phase reserve/commit protocol as the
+reference: prepare on every chosen raylet, commit only if all prepared,
+else cancel (node_manager.cc:1832,1848 equivalents live in raylet.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Any
+
+from ray_tpu._private import scheduler as sched
+from ray_tpu._private.config import global_config
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+
+
+class GcsService:
+    def __init__(self):
+        self._lock = threading.RLock()
+        # namespace -> key -> value
+        self._kv: dict[str, dict[bytes, bytes]] = defaultdict(dict)
+        # node_id(bytes) -> {address, resources, labels, alive, last_heartbeat}
+        self.nodes: dict[bytes, dict] = {}
+        # actor_id(bytes) -> {state, class_name, node_id, raylet_address,
+        #                     num_restarts, max_restarts, spec}
+        self.actors: dict[bytes, dict] = {}
+        # pg_id(bytes) -> {bundles, strategy, state, allocations}
+        self.placement_groups: dict[bytes, dict] = {}
+        self._job_counter = 0
+        # topic -> set of conns
+        self._subs: dict[str, set] = defaultdict(set)
+        self._raylet_clients: dict[bytes, RpcClient] = {}
+        self._task_events: list[dict] = []
+        self.server: RpcServer | None = None
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="gcs-health"
+        )
+        self._stopped = threading.Event()
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.server = RpcServer(self, host, port)
+        self._health_thread.start()
+        return self.server.address
+
+    def stop(self) -> None:
+        self._stopped.set()
+        for c in self._raylet_clients.values():
+            c.close()
+        if self.server:
+            self.server.stop()
+
+    # ---------------- internal helpers ----------------
+
+    def _raylet(self, node_id: bytes) -> RpcClient:
+        with self._lock:
+            client = self._raylet_clients.get(node_id)
+            if client is None:
+                client = RpcClient(self.nodes[node_id]["address"])
+                self._raylet_clients[node_id] = client
+            return client
+
+    def _publish(self, topic: str, payload: Any) -> None:
+        with self._lock:
+            conns = list(self._subs.get(topic, ()))
+        for conn in conns:
+            if not conn.notify(topic, payload):
+                with self._lock:
+                    self._subs[topic].discard(conn)
+
+    def _health_loop(self) -> None:
+        cfg = global_config()
+        interval = cfg.gcs_heartbeat_interval_ms / 1000.0
+        threshold = cfg.health_check_failure_threshold
+        while not self._stopped.wait(interval):
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for node_id, info in self.nodes.items():
+                    if not info["alive"]:
+                        continue
+                    if now - info["last_heartbeat"] > interval * threshold:
+                        info["alive"] = False
+                        dead.append(node_id)
+            for node_id in dead:
+                self._on_node_death(node_id)
+
+    def _on_node_death(self, node_id: bytes) -> None:
+        """Broadcast death; fail actors on that node (restart handled by owner
+        resubmission in round 1 — reference restarts centrally via
+        GcsActorManager::RestartActor)."""
+        self._publish("node_death", {"node_id": node_id})
+        with self._lock:
+            affected = [
+                aid for aid, a in self.actors.items() if a.get("node_id") == node_id
+            ]
+            for aid in affected:
+                self.actors[aid]["state"] = "DEAD"
+        for aid in affected:
+            self._publish("actor:" + aid.hex(), {"state": "DEAD", "reason": "node died"})
+
+    # ---------------- RPC: KV ----------------
+
+    def rpc_kv_put(self, conn, msgid, p):
+        with self._lock:
+            ns = self._kv[p.get("ns", "default")]
+            existed = p["key"] in ns
+            if p.get("overwrite", True) or not existed:
+                ns[p["key"]] = p["value"]
+        return {"added": not existed}
+
+    def rpc_kv_get(self, conn, msgid, p):
+        with self._lock:
+            return {"value": self._kv[p.get("ns", "default")].get(p["key"])}
+
+    def rpc_kv_del(self, conn, msgid, p):
+        with self._lock:
+            return {"deleted": self._kv[p.get("ns", "default")].pop(p["key"], None) is not None}
+
+    def rpc_kv_keys(self, conn, msgid, p):
+        prefix = p.get("prefix", b"")
+        with self._lock:
+            return {"keys": [k for k in self._kv[p.get("ns", "default")] if k.startswith(prefix)]}
+
+    # ---------------- RPC: nodes ----------------
+
+    def rpc_register_node(self, conn, msgid, p):
+        with self._lock:
+            self.nodes[p["node_id"]] = {
+                "address": p["address"],
+                "resources": p["resources"],
+                "labels": p.get("labels", {}),
+                "alive": True,
+                "last_heartbeat": time.monotonic(),
+            }
+        self._publish("node_added", {"node_id": p["node_id"], "address": p["address"]})
+        return {"ok": True}
+
+    def rpc_heartbeat(self, conn, msgid, p):
+        """Periodic resource report — the RaySyncer-gossip analog
+        (reference: src/ray/common/ray_syncer/ray_syncer.h:86)."""
+        with self._lock:
+            info = self.nodes.get(p["node_id"])
+            if info is None:
+                return {"ok": False, "reregister": True}
+            info["last_heartbeat"] = time.monotonic()
+            info["alive"] = True
+            if "available" in p:
+                info["available"] = p["available"]
+            if "load" in p:
+                info["load"] = p["load"]
+        return {"ok": True}
+
+    def rpc_drain_node(self, conn, msgid, p):
+        with self._lock:
+            info = self.nodes.get(p["node_id"])
+            if info is not None:
+                info["alive"] = False
+        self._on_node_death(p["node_id"])
+        return {"ok": True}
+
+    def rpc_get_nodes(self, conn, msgid, p):
+        with self._lock:
+            return {
+                "nodes": [
+                    {
+                        "node_id": nid,
+                        "address": n["address"],
+                        "resources": n["resources"],
+                        "labels": n["labels"],
+                        "alive": n["alive"],
+                        "available": n.get("available", n["resources"]),
+                    }
+                    for nid, n in self.nodes.items()
+                ]
+            }
+
+    def rpc_cluster_resources(self, conn, msgid, p):
+        total: dict[str, float] = defaultdict(float)
+        available: dict[str, float] = defaultdict(float)
+        with self._lock:
+            for n in self.nodes.values():
+                if not n["alive"]:
+                    continue
+                for k, v in n["resources"].items():
+                    total[k] += v
+                for k, v in n.get("available", n["resources"]).items():
+                    available[k] += v
+        return {"total": dict(total), "available": dict(available)}
+
+    # ---------------- RPC: jobs ----------------
+
+    def rpc_next_job_id(self, conn, msgid, p):
+        with self._lock:
+            self._job_counter += 1
+            return {"job_id": self._job_counter.to_bytes(4, "little")}
+
+    # ---------------- RPC: actors ----------------
+
+    def rpc_register_actor(self, conn, msgid, p):
+        with self._lock:
+            self.actors[p["actor_id"]] = {
+                "state": "PENDING_CREATION",
+                "class_name": p.get("class_name", ""),
+                "name": p.get("name"),
+                "node_id": None,
+                "raylet_address": None,
+                "num_restarts": 0,
+                "max_restarts": p.get("max_restarts", 0),
+            }
+        return {"ok": True}
+
+    def rpc_update_actor(self, conn, msgid, p):
+        aid = p["actor_id"]
+        with self._lock:
+            actor = self.actors.get(aid)
+            if actor is None:
+                return {"ok": False}
+            actor.update(
+                {k: p[k] for k in ("state", "node_id", "raylet_address", "worker_id") if k in p}
+            )
+            if p.get("increment_restarts"):
+                actor["num_restarts"] += 1
+            snapshot = dict(actor)
+        self._publish("actor:" + aid.hex(), snapshot)
+        return {"ok": True}
+
+    def rpc_get_actor(self, conn, msgid, p):
+        with self._lock:
+            actor = self.actors.get(p["actor_id"])
+            return {"actor": dict(actor) if actor else None}
+
+    def rpc_get_named_actor(self, conn, msgid, p):
+        with self._lock:
+            for aid, a in self.actors.items():
+                if a.get("name") == p["name"] and a["state"] != "DEAD":
+                    return {"actor_id": aid, "actor": dict(a)}
+        return {"actor_id": None, "actor": None}
+
+    def rpc_list_actors(self, conn, msgid, p):
+        with self._lock:
+            return {
+                "actors": [
+                    dict(a, actor_id=aid) for aid, a in self.actors.items()
+                ]
+            }
+
+    # ---------------- RPC: placement groups ----------------
+
+    def rpc_create_placement_group(self, conn, msgid, p):
+        """Two-phase bundle reservation across raylets
+        (reference: gcs_placement_group_scheduler.cc:884)."""
+        pg_id = p["pg_id"]
+        bundles: list[dict[str, float]] = p["bundles"]
+        strategy = p.get("strategy", "PACK")
+        with self._lock:
+            nodes = {
+                nid: dict(n) for nid, n in self.nodes.items() if n["alive"]
+            }
+        placement = sched.schedule_bundles(bundles, strategy, nodes)
+        if placement is None:
+            with self._lock:
+                self.placement_groups[pg_id] = {
+                    "bundles": bundles,
+                    "strategy": strategy,
+                    "state": "PENDING",
+                    "allocations": None,
+                }
+            return {"ok": False, "state": "PENDING",
+                    "reason": "infeasible or insufficient resources"}
+
+        # Phase 1: prepare on each raylet.
+        prepared: list[tuple[bytes, int]] = []
+        ok = True
+        for bundle_index, node_id in enumerate(placement):
+            try:
+                r = self._raylet(node_id).call(
+                    "prepare_bundle",
+                    {"pg_id": pg_id, "bundle_index": bundle_index,
+                     "resources": bundles[bundle_index]},
+                    timeout=10,
+                )
+                if not r.get("ok"):
+                    ok = False
+                    break
+                prepared.append((node_id, bundle_index))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for node_id, bundle_index in prepared:
+                try:
+                    self._raylet(node_id).call(
+                        "cancel_bundle", {"pg_id": pg_id, "bundle_index": bundle_index}
+                    )
+                except Exception:
+                    pass
+            return {"ok": False, "state": "PENDING", "reason": "prepare failed"}
+        # Phase 2: commit. A node dying mid-commit rolls back the whole
+        # group so no prepared reservation leaks.
+        committed: list[tuple[bytes, int]] = []
+        try:
+            for node_id, bundle_index in prepared:
+                self._raylet(node_id).call(
+                    "commit_bundle", {"pg_id": pg_id, "bundle_index": bundle_index}
+                )
+                committed.append((node_id, bundle_index))
+        except Exception:
+            for node_id, bundle_index in prepared:
+                try:
+                    self._raylet(node_id).call(
+                        "cancel_bundle",
+                        {"pg_id": pg_id, "bundle_index": bundle_index},
+                    )
+                except Exception:
+                    pass
+            return {"ok": False, "state": "PENDING", "reason": "commit failed"}
+        with self._lock:
+            self.placement_groups[pg_id] = {
+                "bundles": bundles,
+                "strategy": strategy,
+                "state": "CREATED",
+                "allocations": [
+                    {"node_id": nid, "bundle_index": bi} for nid, bi in prepared
+                ],
+            }
+        self._publish("pg:" + pg_id.hex(), {"state": "CREATED"})
+        return {"ok": True, "state": "CREATED",
+                "allocations": self.placement_groups[pg_id]["allocations"]}
+
+    def rpc_remove_placement_group(self, conn, msgid, p):
+        pg_id = p["pg_id"]
+        with self._lock:
+            pg = self.placement_groups.get(pg_id)
+        if pg and pg.get("allocations"):
+            for alloc in pg["allocations"]:
+                try:
+                    self._raylet(alloc["node_id"]).call(
+                        "return_bundle",
+                        {"pg_id": pg_id, "bundle_index": alloc["bundle_index"]},
+                    )
+                except Exception:
+                    pass
+        with self._lock:
+            if pg_id in self.placement_groups:
+                self.placement_groups[pg_id]["state"] = "REMOVED"
+        return {"ok": True}
+
+    def rpc_get_placement_group(self, conn, msgid, p):
+        with self._lock:
+            pg = self.placement_groups.get(p["pg_id"])
+            return {"pg": dict(pg) if pg else None}
+
+    # ---------------- RPC: pubsub ----------------
+
+    def rpc_subscribe(self, conn, msgid, p):
+        with self._lock:
+            self._subs[p["topic"]].add(conn)
+        conn.on_close.append(lambda c: self._unsub_all(c))
+        return {"ok": True}
+
+    def rpc_unsubscribe(self, conn, msgid, p):
+        with self._lock:
+            self._subs[p["topic"]].discard(conn)
+        return {"ok": True}
+
+    def _unsub_all(self, conn) -> None:
+        with self._lock:
+            for subs in self._subs.values():
+                subs.discard(conn)
+
+    def rpc_publish(self, conn, msgid, p):
+        self._publish(p["topic"], p["payload"])
+        return {"ok": True}
+
+    # ---------------- RPC: task events (observability) ----------------
+
+    def rpc_add_task_events(self, conn, msgid, p):
+        cfg = global_config()
+        with self._lock:
+            self._task_events.extend(p["events"])
+            overflow = len(self._task_events) - cfg.task_events_buffer_size
+            if overflow > 0:
+                del self._task_events[:overflow]
+        return {"ok": True}
+
+    def rpc_list_task_events(self, conn, msgid, p):
+        with self._lock:
+            events = list(self._task_events)
+        if p and p.get("job_id"):
+            events = [e for e in events if e.get("job_id") == p["job_id"]]
+        return {"events": events}

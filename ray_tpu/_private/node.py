@@ -1,0 +1,169 @@
+"""Node bootstrap: object store daemon + GCS + raylet for one host.
+
+Equivalent of the reference's node bootstrap
+(reference: python/ray/_private/node.py — Node.start_head_processes:1395
+spawns gcs_server, start_ray_processes:1424 spawns the raylet which embeds
+plasma). Here the store is a real subprocess (C++ daemon); GCS and raylet
+run as threads in the driver process by default — same protocol, fewer
+processes — and the `Cluster` harness stacks extra in-process raylets for
+multi-node tests (reference: python/ray/cluster_utils.py:108).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import tempfile
+import uuid
+
+from ray_tpu._private.config import global_config
+from ray_tpu._private.gcs import GcsService
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.object_store import start_store
+from ray_tpu._private.raylet import Raylet
+
+
+def autodetect_tpu_chips() -> int:
+    """Detect local TPU chips without initializing JAX.
+
+    Reference: python/ray/_private/accelerator.py:153 _autodetect_num_tpus
+    reads /dev/accel* and GKE env vars. We honor TPU_CHIPS_OVERRIDE for
+    tests, /dev/accel* device files, and fall back to 0.
+    """
+    override = os.environ.get("RT_NUM_TPUS")
+    if override:
+        return int(override)
+    try:
+        return len([d for d in os.listdir("/dev") if d.startswith("accel")])
+    except OSError:
+        return 0
+
+
+class NodeHandle:
+    def __init__(self, *, gcs: GcsService | None, gcs_address: str,
+                 raylet: Raylet, store_proc, store_socket: str, session_dir: str):
+        self.gcs = gcs
+        self.gcs_address = gcs_address
+        self.raylet = raylet
+        self.store_proc = store_proc
+        self.store_socket = store_socket
+        self.session_dir = session_dir
+        self.node_id = raylet.node_id
+
+    def shutdown(self) -> None:
+        self.raylet.stop()
+        if self.gcs is not None:
+            self.gcs.stop()
+        if self.store_proc is not None:
+            try:
+                self.store_proc.terminate()
+                self.store_proc.wait(timeout=5)
+            except Exception:
+                pass
+
+
+def start_head(
+    *,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    resources: dict[str, float] | None = None,
+    labels: dict[str, str] | None = None,
+    object_store_memory: int | None = None,
+) -> NodeHandle:
+    cfg = global_config()
+    session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
+    store_socket = os.path.join(session_dir, "store.sock")
+    store_proc = start_store(
+        store_socket, object_store_memory or cfg.object_store_memory_bytes
+    )
+
+    gcs = GcsService()
+    gcs_address = gcs.start()
+
+    node_resources = dict(resources or {})
+    node_resources.setdefault("CPU", float(num_cpus if num_cpus is not None else os.cpu_count() or 1))
+    node_resources.setdefault(
+        "TPU", float(num_tpus if num_tpus is not None else autodetect_tpu_chips())
+    )
+    node_resources.setdefault("memory", float(2 * 1024**3))
+    node_labels = dict(labels or {})
+    if node_resources["TPU"] > 0:
+        node_labels.setdefault("ici-domain", "slice-0")
+
+    raylet = Raylet(
+        NodeID.from_random(), gcs_address, store_socket, node_resources, node_labels
+    )
+    handle = NodeHandle(
+        gcs=gcs,
+        gcs_address=gcs_address,
+        raylet=raylet,
+        store_proc=store_proc,
+        store_socket=store_socket,
+        session_dir=session_dir,
+    )
+    atexit.register(handle.shutdown)
+    return handle
+
+
+class Cluster:
+    """In-process fake multi-node cluster for tests.
+
+    Reference: python/ray/cluster_utils.py:108 Cluster — extra raylets in one
+    process against one GCS. All nodes share the single host store (valid:
+    on one physical host the reference's plasma is also per-node but our
+    tests only assert scheduling semantics, not store isolation).
+    """
+
+    def __init__(self, head_resources: dict[str, float] | None = None):
+        self.head = start_head(
+            num_cpus=(head_resources or {}).get("CPU", 2),
+            num_tpus=(head_resources or {}).get("TPU", 0),
+            resources={
+                k: v for k, v in (head_resources or {}).items() if k not in ("CPU", "TPU")
+            },
+        )
+        self.nodes: list[Raylet] = [self.head.raylet]
+
+    @property
+    def gcs_address(self) -> str:
+        return self.head.gcs_address
+
+    def add_node(
+        self,
+        *,
+        num_cpus: float = 1,
+        num_tpus: float = 0,
+        resources: dict[str, float] | None = None,
+        labels: dict[str, str] | None = None,
+    ) -> Raylet:
+        node_resources = dict(resources or {})
+        node_resources["CPU"] = float(num_cpus)
+        node_resources["TPU"] = float(num_tpus)
+        node_resources.setdefault("memory", float(2 * 1024**3))
+        node_labels = dict(labels or {})
+        if num_tpus > 0:
+            node_labels.setdefault("ici-domain", f"slice-{len(self.nodes)}")
+        raylet = Raylet(
+            NodeID.from_random(),
+            self.head.gcs_address,
+            self.head.store_socket,
+            node_resources,
+            node_labels,
+        )
+        self.nodes.append(raylet)
+        return raylet
+
+    def remove_node(self, raylet: Raylet) -> None:
+        raylet.stop()
+        self.nodes.remove(raylet)
+        try:
+            self.head.gcs.rpc_drain_node(None, 0, {"node_id": raylet.node_id.binary()})
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        for raylet in self.nodes[1:]:
+            try:
+                raylet.stop()
+            except Exception:
+                pass
+        self.head.shutdown()

@@ -1,0 +1,268 @@
+"""Control-plane RPC: msgpack-framed messages over TCP.
+
+Equivalent in role to the reference's gRPC wrapper layer
+(reference: src/ray/rpc/grpc_server.h, client_call.h — async server/client
+call templates). The control plane here is deliberately small: length-prefixed
+msgpack arrays over TCP, thread-per-connection servers, plus server→client
+push notifications (used for task completion, pubsub delivery, and actor
+state changes — the analog of the reference's long-poll pubsub,
+src/ray/pubsub/publisher.h).
+
+Wire format: [u32 len][msgpack array]
+  request:  [0, msgid, method: str, payload]
+  response: [1, msgid, ok: bool, payload_or_error]
+  notify:   [2, 0, topic: str, payload]
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import traceback
+from concurrent.futures import Future
+from typing import Any, Callable
+
+import msgpack
+
+REQUEST, RESPONSE, NOTIFY = 0, 1, 2
+
+
+def _pack(obj: Any) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return struct.pack("<I", len(body)) + body
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    chunks = []
+    while n > 0:
+        try:
+            c = sock.recv(n)
+        except OSError:
+            return None
+        if not c:
+            return None
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _read_msg(sock: socket.socket) -> list | None:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack("<I", header)
+    body = _recv_exact(sock, length)
+    if body is None:
+        return None
+    return msgpack.unpackb(body, raw=False)
+
+
+class Connection:
+    """Server-side handle to one client connection; safe concurrent sends."""
+
+    def __init__(self, sock: socket.socket, peer: str):
+        self.sock = sock
+        self.peer = peer
+        self._send_lock = threading.Lock()
+        self.closed = False
+        # Services can attach identity here (e.g. worker id after register).
+        self.meta: dict[str, Any] = {}
+        self.on_close: list[Callable[[Connection], None]] = []
+
+    def send(self, msg: list) -> bool:
+        data = _pack(msg)
+        with self._send_lock:
+            try:
+                self.sock.sendall(data)
+                return True
+            except OSError:
+                return False
+
+    def notify(self, topic: str, payload: Any) -> bool:
+        return self.send([NOTIFY, 0, topic, payload])
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class RpcServer:
+    """Thread-per-connection RPC server dispatching to handler methods.
+
+    Handlers are methods named ``rpc_<method>`` on the service object, called
+    as ``handler(conn, msgid, payload)``; the return value is the response
+    payload.
+    A handler may instead return the DEFERRED sentinel and later complete the
+    call via ``conn.send([RESPONSE, msgid, True, payload])`` — used for
+    blocking calls (e.g. waiting on an actor to start) without tying up the
+    connection's request loop.
+    """
+
+    DEFERRED = object()
+
+    def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0):
+        self.service = service
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(512)
+        self.address = f"{host}:{self._srv.getsockname()[1]}"
+        self._stopped = threading.Event()
+        self.connections: set[Connection] = set()
+        self._conn_lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"rpc-accept-{self.address}"
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, addr = self._srv.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = Connection(sock, f"{addr[0]}:{addr[1]}")
+            with self._conn_lock:
+                self.connections.add(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name=f"rpc-conn-{conn.peer}",
+            ).start()
+
+    def _serve_conn(self, conn: Connection) -> None:
+        try:
+            while not self._stopped.is_set():
+                msg = _read_msg(conn.sock)
+                if msg is None:
+                    break
+                mtype, msgid, method, payload = msg
+                if mtype != REQUEST:
+                    continue
+                handler = getattr(self.service, "rpc_" + method, None)
+                if handler is None:
+                    conn.send([RESPONSE, msgid, False, f"no such method: {method}"])
+                    continue
+                try:
+                    result = handler(conn, msgid, payload)
+                    if result is not RpcServer.DEFERRED:
+                        conn.send([RESPONSE, msgid, True, result])
+                except Exception:
+                    conn.send([RESPONSE, msgid, False, traceback.format_exc()])
+        finally:
+            with self._conn_lock:
+                self.connections.discard(conn)
+            for cb in conn.on_close:
+                try:
+                    cb(conn)
+                except Exception:
+                    pass
+            conn.close()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for conn in list(self.connections):
+                conn.close()
+
+
+class RpcClient:
+    """Blocking request/response client with a background reader thread.
+
+    Push notifications are delivered to ``notify_handler(topic, payload)``
+    on the reader thread — handlers must be quick or hand off.
+    """
+
+    def __init__(
+        self,
+        address: str,
+        notify_handler: Callable[[str, Any], None] | None = None,
+        connect_timeout: float = 10.0,
+    ):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.address = address
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._msgid = 0
+        self._notify_handler = notify_handler
+        self._closed = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name=f"rpc-client-{address}"
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        while not self._closed.is_set():
+            msg = _read_msg(self._sock)
+            if msg is None:
+                break
+            mtype = msg[0]
+            if mtype == RESPONSE:
+                _, msgid, ok, payload = msg
+                with self._pending_lock:
+                    fut = self._pending.pop(msgid, None)
+                if fut is not None:
+                    if ok:
+                        fut.set_result(payload)
+                    else:
+                        fut.set_exception(RpcError(str(payload)))
+            elif mtype == NOTIFY and self._notify_handler is not None:
+                _, _, topic, payload = msg
+                try:
+                    self._notify_handler(topic, payload)
+                except Exception:
+                    traceback.print_exc()
+        # Connection lost: fail all pending calls.
+        with self._pending_lock:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError(f"connection to {self.address} lost"))
+            self._pending.clear()
+
+    def call_async(self, method: str, payload: Any = None) -> Future:
+        with self._pending_lock:
+            self._msgid += 1
+            msgid = self._msgid
+            fut: Future = Future()
+            self._pending[msgid] = fut
+        data = _pack([REQUEST, msgid, method, payload])
+        with self._send_lock:
+            try:
+                self._sock.sendall(data)
+            except OSError as e:
+                with self._pending_lock:
+                    self._pending.pop(msgid, None)
+                # The reader thread's connection-lost cleanup may have
+                # already failed this future — don't double-complete.
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError(f"send to {self.address} failed: {e}")
+                    )
+        return fut
+
+    def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
+        return self.call_async(method, payload).result(timeout)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class RpcError(Exception):
+    """Remote handler raised; message carries the remote traceback."""

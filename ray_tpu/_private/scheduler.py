@@ -1,0 +1,157 @@
+"""Cluster scheduling policies: node selection and bundle placement.
+
+Equivalent of the reference's scheduling policy layer
+(reference: src/ray/raylet/scheduling/policy/ — hybrid top-k
+(hybrid_scheduling_policy.h:50), spread, node-affinity, and the bundle
+policies PACK/SPREAD/STRICT_PACK/STRICT_SPREAD
+(bundle_scheduling_policy.cc)). TPU-first addition: bundles that request
+``TPU`` prefer nodes sharing an ``ici-domain`` label so a gang lands on one
+ICI-connected slice (STRICT_PACK over an ICI domain = "slice bundle").
+"""
+from __future__ import annotations
+
+import random
+from typing import Sequence
+
+
+def fits(resources: dict[str, float], available: dict[str, float]) -> bool:
+    return all(available.get(k, 0.0) + 1e-9 >= v for k, v in resources.items())
+
+
+def subtract(available: dict[str, float], resources: dict[str, float]) -> None:
+    for k, v in resources.items():
+        available[k] = available.get(k, 0.0) - v
+
+
+def add(available: dict[str, float], resources: dict[str, float]) -> None:
+    for k, v in resources.items():
+        available[k] = available.get(k, 0.0) + v
+
+
+def pick_node(
+    resources: dict[str, float],
+    nodes: dict[bytes, dict],
+    *,
+    strategy: str = "default",
+    local_node_id: bytes | None = None,
+    affinity_node_id: bytes | None = None,
+    soft: bool = False,
+) -> bytes | None:
+    """Pick a node for one task. ``nodes[nid]['available']`` must be present.
+
+    default (hybrid): local node first if it fits, else the *most* loaded
+    feasible remote node (pack; reference hybrid policy packs up to a
+    threshold before spreading). spread: least-loaded feasible node.
+    """
+    feasible = [
+        nid
+        for nid, n in nodes.items()
+        if n.get("alive", True) and fits(resources, n.get("available", n["resources"]))
+    ]
+    if strategy == "node_affinity":
+        if affinity_node_id in feasible:
+            return affinity_node_id
+        if not soft:
+            return None
+        # soft affinity falls through to default choice
+    if not feasible:
+        return None
+    if strategy == "spread":
+        return max(
+            feasible,
+            key=lambda nid: _avail_frac(nodes[nid]) + random.random() * 1e-6,
+        )
+    # default/hybrid
+    if local_node_id in feasible:
+        return local_node_id
+    return min(feasible, key=lambda nid: _avail_frac(nodes[nid]))
+
+
+def _avail_frac(node: dict) -> float:
+    total = node["resources"]
+    avail = node.get("available", total)
+    cpu_total = total.get("CPU", 1.0) or 1.0
+    return avail.get("CPU", 0.0) / cpu_total
+
+
+def schedule_bundles(
+    bundles: Sequence[dict[str, float]],
+    strategy: str,
+    nodes: dict[bytes, dict],
+) -> list[bytes] | None:
+    """Map each bundle to a node id, or None if infeasible.
+
+    Reference: bundle_scheduling_policy.cc — PACK (best effort co-locate),
+    SPREAD (best effort spread), STRICT_PACK (all on one node),
+    STRICT_SPREAD (all on distinct nodes).
+    """
+    avail = {
+        nid: dict(n.get("available", n["resources"]))
+        for nid, n in nodes.items()
+        if n.get("alive", True)
+    }
+    if not avail:
+        return None
+
+    def tpu_domain(nid: bytes) -> str:
+        return nodes[nid].get("labels", {}).get("ici-domain", "")
+
+    wants_tpu = any(b.get("TPU", 0) > 0 for b in bundles)
+
+    if strategy == "STRICT_PACK":
+        for nid in sorted(avail, key=lambda n: -sum(avail[n].values())):
+            trial = dict(avail[nid])
+            if all(_try_place(b, trial) for b in bundles):
+                return [nid] * len(bundles)
+        return None
+
+    if strategy == "STRICT_SPREAD":
+        placement: list[bytes] = []
+        used: set[bytes] = set()
+        for b in bundles:
+            cands = [nid for nid in avail if nid not in used and fits(b, avail[nid])]
+            if not cands:
+                return None
+            if wants_tpu and placement:
+                dom = tpu_domain(placement[0])
+                same = [c for c in cands if tpu_domain(c) == dom]
+                cands = same or cands
+            nid = cands[0]
+            subtract(avail[nid], b)
+            placement.append(nid)
+            used.add(nid)
+        return placement
+
+    # PACK / SPREAD (best-effort)
+    placement = []
+    order = (
+        sorted(avail, key=lambda n: -sum(avail[n].values()))
+        if strategy == "PACK"
+        else sorted(avail, key=lambda n: sum(avail[n].values()))
+    )
+    for b in bundles:
+        chosen = None
+        cands = [nid for nid in order if fits(b, avail[nid])]
+        if wants_tpu and placement:
+            dom = tpu_domain(placement[0])
+            same = [c for c in cands if tpu_domain(c) == dom]
+            cands = same or cands
+        if strategy == "PACK":
+            # prefer nodes already hosting earlier bundles of this group
+            hosting = [c for c in cands if c in placement]
+            chosen = (hosting or cands or [None])[0]
+        else:
+            not_hosting = [c for c in cands if c not in placement]
+            chosen = (not_hosting or cands or [None])[0]
+        if chosen is None:
+            return None
+        subtract(avail[chosen], b)
+        placement.append(chosen)
+    return placement
+
+
+def _try_place(bundle: dict[str, float], avail: dict[str, float]) -> bool:
+    if fits(bundle, avail):
+        subtract(avail, bundle)
+        return True
+    return False

@@ -1,0 +1,99 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Equivalent of the reference's serialization layer
+(reference: python/ray/_private/serialization.py — cloudpickle with protocol-5
+out-of-band buffers so large numpy arrays are written zero-copy into plasma).
+Here the wire format is::
+
+    [u32 nbuf] [u64 meta_len] [meta pickle bytes] [u64 len, buf bytes]*
+
+Large contiguous buffers (numpy arrays, jax host arrays, bytes) are carried
+out-of-band so the object-store write path can splice them without copying
+through pickle, and the read path can reconstruct arrays as zero-copy views
+onto the shared-memory mapping.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any
+
+import cloudpickle
+
+# Buffers smaller than this are kept in-band; the indirection isn't worth it.
+_OOB_THRESHOLD = 4096
+
+
+def serialize(value: Any) -> list[bytes | memoryview]:
+    """Serialize to a list of chunks: header + meta + raw buffers.
+
+    Returns a chunk list rather than one bytes object so callers can write
+    the chunks straight into a shared-memory allocation without an extra
+    concatenation copy.
+    """
+    buffers: list[pickle.PickleBuffer] = []
+
+    def buffer_callback(pb: pickle.PickleBuffer) -> bool:
+        with pb.raw() as m:
+            if m.nbytes < _OOB_THRESHOLD:
+                return True  # keep small buffers in-band
+        buffers.append(pb)
+        return False
+
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    chunks: list[bytes | memoryview] = []
+    raw_views = []
+    for pb in buffers:
+        m = pb.raw()
+        raw_views.append(m if m.contiguous else memoryview(bytes(m)))
+    header = struct.pack("<IQ", len(raw_views), len(meta))
+    chunks.append(header)
+    chunks.append(meta)
+    for m in raw_views:
+        chunks.append(struct.pack("<Q", m.nbytes))
+        chunks.append(m)
+    return chunks
+
+
+def serialized_size(chunks: list[bytes | memoryview]) -> int:
+    return sum(c.nbytes if isinstance(c, memoryview) else len(c) for c in chunks)
+
+
+def write_chunks(chunks: list[bytes | memoryview], dest: memoryview) -> None:
+    offset = 0
+    for c in chunks:
+        n = c.nbytes if isinstance(c, memoryview) else len(c)
+        dest[offset : offset + n] = c
+        offset += n
+
+
+def dumps(value: Any) -> bytes:
+    out = io.BytesIO()
+    for c in serialize(value):
+        out.write(c)
+    return out.getvalue()
+
+
+def deserialize(data: bytes | memoryview) -> Any:
+    """Deserialize from one contiguous buffer, zero-copy for array payloads.
+
+    When ``data`` is a memoryview over shared memory, reconstructed numpy
+    arrays alias that memory — the caller must keep the mapping alive for
+    the lifetime of the returned object (the ObjectRef pinning does this).
+    """
+    view = memoryview(data)
+    nbuf, meta_len = struct.unpack_from("<IQ", view, 0)
+    offset = 12
+    meta = view[offset : offset + meta_len]
+    offset += meta_len
+    out_of_band = []
+    for _ in range(nbuf):
+        (blen,) = struct.unpack_from("<Q", view, offset)
+        offset += 8
+        out_of_band.append(view[offset : offset + blen])
+        offset += blen
+    return pickle.loads(meta, buffers=out_of_band)
+
+
+loads = deserialize

@@ -1,0 +1,166 @@
+"""Actor API: ActorClass (decorated class) and ActorHandle.
+
+Equivalent of the reference's actor layer
+(reference: python/ray/actor.py — ActorClass:384, ActorHandle:1025,
+ActorClass._remote:667 builds the creation TaskSpec; actor method calls
+become ordered actor tasks). Handles are picklable: a deserialized handle
+routes through the GCS actor table to the hosting raylet.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private import task_spec as ts
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.worker import global_worker
+from ray_tpu.exceptions import ActorDiedError
+
+
+class ActorClass:
+    def __init__(self, cls, *, num_cpus=1, num_tpus=0, resources=None,
+                 max_restarts=0, name=None, lifetime=None,
+                 scheduling_strategy=None, runtime_env=None, max_concurrency=1):
+        self._cls = cls
+        self._class_name = cls.__name__
+        self._class_blob = ts.dumps_function(cls)
+        self._resources = dict(resources or {})
+        self._resources.setdefault("CPU", float(num_cpus))
+        if num_tpus:
+            self._resources["TPU"] = float(num_tpus)
+        self._max_restarts = max_restarts
+        self._name = name
+        self._lifetime = lifetime
+        self._scheduling_strategy = scheduling_strategy
+        self._runtime_env = runtime_env
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Actor class {self._class_name} cannot be instantiated directly; "
+            f"use {self._class_name}.remote(...)"
+        )
+
+    def options(self, **opts) -> "ActorClass":
+        clone = ActorClass.__new__(ActorClass)
+        clone.__dict__.update(self.__dict__)
+        res = dict(clone._resources)
+        if "num_cpus" in opts:
+            res["CPU"] = float(opts["num_cpus"])
+        if "num_tpus" in opts:
+            res["TPU"] = float(opts["num_tpus"])
+        if "resources" in opts:
+            res.update(opts["resources"])
+        clone._resources = res
+        for key in ("max_restarts", "name", "lifetime", "scheduling_strategy", "runtime_env"):
+            if key in opts:
+                setattr(clone, "_" + key, opts[key])
+        return clone
+
+    def remote(self, *args, **kwargs) -> "ActorHandle":
+        worker = global_worker()
+        actor_id = ActorID.of(worker.job_id)
+        worker.gcs.call(
+            "register_actor",
+            {
+                "actor_id": actor_id.binary(),
+                "class_name": self._class_name,
+                "name": self._name,
+                "max_restarts": self._max_restarts,
+            },
+        )
+        from ray_tpu.remote_function import _strategy_fields
+
+        placement, scheduling = _strategy_fields(self._scheduling_strategy)
+        spec = ts.make_task_spec(
+            task_id=worker.new_task_id(),
+            job_id=worker.job_id,
+            name=f"{self._class_name}.__init__",
+            task_type=ts.ACTOR_CREATION,
+            function_blob=self._class_blob,
+            args=args,
+            kwargs=kwargs,
+            num_returns=1,
+            resources=self._resources,
+            actor_id=actor_id,
+            max_restarts=self._max_restarts,
+            placement=placement,
+            scheduling=scheduling,
+            runtime_env=self._runtime_env,
+        )
+        worker.submit_task(spec)
+        return ActorHandle(actor_id, self._class_name)
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, *, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        worker = global_worker()
+        h = self._handle
+        raylet_addr = worker.actor_raylet_address(h._actor_id)
+        spec = ts.make_task_spec(
+            task_id=ts.TaskID.for_actor_task(h._actor_id),
+            job_id=worker.job_id,
+            name=f"{h._class_name}.{self._method_name}",
+            task_type=ts.ACTOR_TASK,
+            method_name=self._method_name,
+            args=args,
+            kwargs=kwargs,
+            num_returns=self._num_returns,
+            resources={},
+            actor_id=h._actor_id,
+            seqno=worker.next_actor_seqno(h._actor_id),
+        )
+        try:
+            refs = worker.submit_actor_task(spec, raylet_addr)
+        except ConnectionError:
+            worker.invalidate_actor_cache(h._actor_id)
+            raise ActorDiedError(h._actor_id.hex(), "raylet connection lost")
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = ""):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+
+def get_actor(name: str) -> ActorHandle:
+    """Look up a named actor (reference: ray.get_actor)."""
+    worker = global_worker()
+    r = worker.gcs.call("get_named_actor", {"name": name})
+    if r["actor_id"] is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(ActorID(r["actor_id"]), r["actor"].get("class_name", ""))
+
+
+def kill(handle: ActorHandle) -> None:
+    """Forcefully terminate an actor (reference: ray.kill)."""
+    worker = global_worker()
+    try:
+        addr = worker.actor_raylet_address(handle._actor_id, timeout=5)
+    except (TimeoutError, ActorDiedError):
+        return
+    client = worker._peer(addr) if addr != worker.raylet.address else worker.raylet
+    client.call("kill_actor", {"actor_id": handle._actor_id.binary()})
+    worker.invalidate_actor_cache(handle._actor_id)

@@ -1,0 +1,426 @@
+// Shared-memory immutable object store daemon ("plasma equivalent").
+//
+// TPU-native rebuild of the reference's plasma store
+// (reference: src/ray/object_manager/plasma/store.h:55 — shared-memory
+// immutable object store embedded in the raylet; dlmalloc arena over mmap,
+// ObjectLifecycleManager + LRU EvictionPolicy, create/get queues, client
+// over unix socket). Design differences, deliberate for the TPU host path:
+//
+//  * One POSIX shm segment **per object** (shm_open) instead of one dlmalloc
+//    arena: clients mmap exactly the object they touch, the kernel reclaims
+//    a segment the moment its refcount drops to zero and it is unlinked, and
+//    host buffers handed to jax.device_put are page-aligned by construction.
+//  * Thread-per-connection unix-socket server (host object churn is a
+//    control-plane rate, not a data-plane rate — data moves via mmap).
+//  * LRU eviction of sealed, unreferenced objects when a create would exceed
+//    the byte budget (reference: plasma/eviction_policy.h:199).
+//
+// Wire protocol (little-endian u32 framing), one request per message:
+//   req:  [u32 len][u8 op][28B object_id][payload]
+//   resp: [u32 len][u8 status][payload]
+// ops: 1=CREATE(u64 size) -> shm name; 2=SEAL; 3=GET(u64 timeout_ms) ->
+//      shm name+size; 4=RELEASE; 5=DELETE; 6=CONTAINS; 7=LIST; 8=STATS;
+//      9=SHUTDOWN.
+// status: 0=OK 1=NOT_FOUND 2=EXISTS 3=FULL 4=TIMEOUT 5=ERR
+//
+// Build: g++ -O2 -std=c++17 -pthread -o ray_tpu_store store.cpp -lrt
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
+                  OP_DELETE = 5, OP_CONTAINS = 6, OP_LIST = 7, OP_STATS = 8,
+                  OP_SHUTDOWN = 9;
+constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_FULL = 3,
+                  ST_TIMEOUT = 4, ST_ERR = 5, ST_EVICTED = 6;
+constexpr size_t ID_SIZE = 28;
+
+struct ObjectEntry {
+  std::string shm_name;
+  uint64_t size = 0;
+  bool sealed = false;
+  int64_t refcount = 0;  // client references; creator holds one until seal
+  uint64_t lru_tick = 0;
+};
+
+class Store {
+ public:
+  explicit Store(uint64_t capacity) : capacity_(capacity) {}
+
+  uint8_t Create(const std::string &id, uint64_t size, std::string *shm_name) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (objects_.count(id)) return ST_EXISTS;
+    tombstones_.erase(id);  // reconstruction recreates an evicted object
+    if (used_ + size > capacity_ && !EvictLocked(size)) return ST_FULL;
+    std::string name = "/rt_store_" + std::to_string(getpid()) + "_" +
+                       Hex(id.substr(0, 8)) + "_" + std::to_string(seq_++);
+    int fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return ST_ERR;
+    if (ftruncate(fd, (off_t)size) != 0) {
+      close(fd);
+      shm_unlink(name.c_str());
+      return ST_FULL;
+    }
+    close(fd);
+    ObjectEntry e;
+    e.shm_name = name;
+    e.size = size;
+    e.refcount = 1;  // creator's reference until Seal
+    objects_[id] = e;
+    used_ += size;
+    *shm_name = name;
+    return ST_OK;
+  }
+
+  // Abort an unsealed create (creator died before seal): remove without
+  // tombstoning so a retry's create() succeeds cleanly.
+  void Abort(const std::string &id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end() || it->second.sealed) return;
+    shm_unlink(it->second.shm_name.c_str());
+    used_ -= it->second.size;
+    objects_.erase(it);
+  }
+
+  uint8_t Seal(const std::string &id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    it->second.sealed = true;
+    it->second.refcount--;  // drop creator ref; object now LRU-evictable at 0
+    it->second.lru_tick = tick_++;
+    sealed_cv_.notify_all();
+    return ST_OK;
+  }
+
+  uint8_t Get(const std::string &id, uint64_t timeout_ms, std::string *shm_name,
+              uint64_t *size) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    for (;;) {
+      auto it = objects_.find(id);
+      if (it != objects_.end() && it->second.sealed) {
+        it->second.refcount++;
+        it->second.lru_tick = tick_++;
+        *shm_name = it->second.shm_name;
+        *size = it->second.size;
+        return ST_OK;
+      }
+      // Evicted objects report distinctly so owners can trigger lineage
+      // reconstruction (reference: ObjectRecoveryManager,
+      // core_worker/object_recovery_manager.h:41).
+      if (it == objects_.end() && tombstones_.count(id)) return ST_EVICTED;
+      if (timeout_ms == 0) return ST_NOT_FOUND;
+      if (sealed_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+        return ST_TIMEOUT;
+    }
+  }
+
+  uint8_t Release(const std::string &id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    if (it->second.refcount > 0) it->second.refcount--;
+    return ST_OK;
+  }
+
+  uint8_t Delete(const std::string &id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return ST_NOT_FOUND;
+    // Unlink now; clients holding an mmap keep their pages until they unmap.
+    shm_unlink(it->second.shm_name.c_str());
+    used_ -= it->second.size;
+    objects_.erase(it);
+    tombstones_.insert(id);
+    return ST_OK;
+  }
+
+  uint8_t Contains(const std::string &id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it != objects_.end() && it->second.sealed) return ST_OK;
+    if (it == objects_.end() && tombstones_.count(id)) return ST_EVICTED;
+    return ST_NOT_FOUND;
+  }
+
+  std::vector<std::string> List() {
+    std::unique_lock<std::mutex> lk(mu_);
+    std::vector<std::string> out;
+    for (auto &kv : objects_)
+      if (kv.second.sealed) out.push_back(kv.first);
+    return out;
+  }
+
+  void Stats(uint64_t *used, uint64_t *capacity, uint64_t *count) {
+    std::unique_lock<std::mutex> lk(mu_);
+    *used = used_;
+    *capacity = capacity_;
+    *count = objects_.size();
+  }
+
+  void UnlinkAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (auto &kv : objects_) shm_unlink(kv.second.shm_name.c_str());
+    objects_.clear();
+    used_ = 0;
+  }
+
+ private:
+  // LRU-evict sealed refcount==0 objects until `needed` fits. Caller holds mu_.
+  bool EvictLocked(uint64_t needed) {
+    while (used_ + needed > capacity_) {
+      std::string victim;
+      uint64_t best_tick = UINT64_MAX;
+      for (auto &kv : objects_) {
+        if (kv.second.sealed && kv.second.refcount == 0 &&
+            kv.second.lru_tick < best_tick) {
+          best_tick = kv.second.lru_tick;
+          victim = kv.first;
+        }
+      }
+      if (victim.empty()) return false;
+      auto it = objects_.find(victim);
+      shm_unlink(it->second.shm_name.c_str());
+      used_ -= it->second.size;
+      objects_.erase(it);
+      tombstones_.insert(victim);
+    }
+    return true;
+  }
+
+  static std::string Hex(const std::string &raw) {
+    static const char *d = "0123456789abcdef";
+    std::string out;
+    for (unsigned char c : raw) {
+      out.push_back(d[c >> 4]);
+      out.push_back(d[c & 15]);
+    }
+    return out;
+  }
+
+  std::mutex mu_;
+  std::condition_variable sealed_cv_;
+  std::unordered_map<std::string, ObjectEntry> objects_;
+  std::unordered_set<std::string> tombstones_;
+  uint64_t capacity_;
+  uint64_t used_ = 0;
+  uint64_t tick_ = 0;
+  uint64_t seq_ = 0;
+};
+
+bool ReadExact(int fd, void *buf, size_t n) {
+  char *p = (char *)buf;
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool WriteExact(int fd, const void *buf, size_t n) {
+  const char *p = (const char *)buf;
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+void SendResp(int fd, uint8_t status, const std::string &payload = "") {
+  uint32_t len = 1 + (uint32_t)payload.size();
+  std::string msg;
+  msg.reserve(4 + len);
+  msg.append((char *)&len, 4);
+  msg.push_back((char)status);
+  msg.append(payload);
+  WriteExact(fd, msg.data(), msg.size());
+}
+
+std::atomic<bool> g_shutdown{false};
+std::atomic<int> g_srv_fd{-1};
+
+void ServeClient(Store *store, int fd) {
+  // Objects this connection created but has not yet sealed; aborted on
+  // disconnect so a crashed creator never leaves a permanently-unsealed
+  // object that wedges getters (reference: plasma AbortObject on client
+  // disconnect, plasma/store.cc DisconnectClient).
+  std::unordered_set<std::string> unsealed;
+  for (;;) {
+    uint32_t len;
+    if (!ReadExact(fd, &len, 4)) break;
+    std::string req(len, '\0');
+    if (!ReadExact(fd, &req[0], len)) break;
+    if (len < 1 + ID_SIZE) {
+      SendResp(fd, ST_ERR);
+      continue;
+    }
+    uint8_t op = (uint8_t)req[0];
+    std::string id = req.substr(1, ID_SIZE);
+    const char *payload = req.data() + 1 + ID_SIZE;
+    size_t payload_len = len - 1 - ID_SIZE;
+
+    switch (op) {
+      case OP_CREATE: {
+        if (payload_len < 8) {
+          SendResp(fd, ST_ERR);
+          break;
+        }
+        uint64_t size;
+        memcpy(&size, payload, 8);
+        std::string name;
+        uint8_t st = store->Create(id, size, &name);
+        if (st == ST_OK) unsealed.insert(id);
+        SendResp(fd, st, st == ST_OK ? name : "");
+        break;
+      }
+      case OP_SEAL: {
+        uint8_t st = store->Seal(id);
+        if (st == ST_OK) unsealed.erase(id);
+        SendResp(fd, st);
+        break;
+      }
+      case OP_GET: {
+        uint64_t timeout_ms = 0;
+        if (payload_len >= 8) memcpy(&timeout_ms, payload, 8);
+        std::string name;
+        uint64_t size = 0;
+        uint8_t st = store->Get(id, timeout_ms, &name, &size);
+        if (st == ST_OK) {
+          std::string out((char *)&size, 8);
+          out += name;
+          SendResp(fd, st, out);
+        } else {
+          SendResp(fd, st);
+        }
+        break;
+      }
+      case OP_RELEASE:
+        SendResp(fd, store->Release(id));
+        break;
+      case OP_DELETE:
+        SendResp(fd, store->Delete(id));
+        break;
+      case OP_CONTAINS:
+        SendResp(fd, store->Contains(id));
+        break;
+      case OP_LIST: {
+        auto ids = store->List();
+        std::string out;
+        uint32_t n = (uint32_t)ids.size();
+        out.append((char *)&n, 4);
+        for (auto &s : ids) out += s;
+        SendResp(fd, ST_OK, out);
+        break;
+      }
+      case OP_STATS: {
+        uint64_t used, cap, count;
+        store->Stats(&used, &cap, &count);
+        std::string out;
+        out.append((char *)&used, 8);
+        out.append((char *)&cap, 8);
+        out.append((char *)&count, 8);
+        SendResp(fd, ST_OK, out);
+        break;
+      }
+      case OP_SHUTDOWN:
+        SendResp(fd, ST_OK);
+        g_shutdown = true;
+        // Unblock the accept() loop so the daemon can exit.
+        if (g_srv_fd >= 0) shutdown(g_srv_fd.load(), SHUT_RDWR);
+        close(fd);
+        return;
+      default:
+        SendResp(fd, ST_ERR);
+    }
+  }
+  for (const auto &id : unsealed) store->Abort(id);
+  close(fd);
+}
+
+}  // namespace
+
+Store *g_store = nullptr;
+const char *g_sock_path = nullptr;
+
+void HandleTerm(int) {
+  // Best-effort cleanup of shm segments + socket on SIGTERM/SIGINT.
+  if (g_store) g_store->UnlinkAll();
+  if (g_sock_path) unlink(g_sock_path);
+  _exit(0);
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <socket_path> <capacity_bytes>\n", argv[0]);
+    return 1;
+  }
+  const char *sock_path = argv[1];
+  uint64_t capacity = strtoull(argv[2], nullptr, 10);
+  Store store(capacity);
+  g_store = &store;
+  g_sock_path = sock_path;
+  signal(SIGTERM, HandleTerm);
+  signal(SIGINT, HandleTerm);
+
+  unlink(sock_path);
+  int srv = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (srv < 0) {
+    perror("socket");
+    return 1;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, sock_path, sizeof(addr.sun_path) - 1);
+  if (bind(srv, (sockaddr *)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 128) != 0) {
+    perror("listen");
+    return 1;
+  }
+  g_srv_fd = srv;
+  // Readiness handshake: parent waits for this line.
+  printf("READY\n");
+  fflush(stdout);
+
+  std::vector<std::thread> threads;
+  while (!g_shutdown) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) break;
+    threads.emplace_back(ServeClient, &store, fd);
+  }
+  for (auto &t : threads)
+    if (t.joinable()) t.detach();
+  store.UnlinkAll();
+  unlink(sock_path);
+  return 0;
+}

@@ -1,0 +1,19 @@
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+    slice_bundle,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "PlacementGroup",
+    "placement_group",
+    "remove_placement_group",
+    "slice_bundle",
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

@@ -1,0 +1,73 @@
+"""Test harness: virtual 8-device CPU mesh + cluster fixtures.
+
+Mirrors the reference's strategy (reference: python/ray/tests/conftest.py:410
+ray_start_regular / :491 ray_start_cluster fixtures; fake accelerators per
+SURVEY.md §4.3): all distributed logic is testable on one machine — JAX tests
+run on an 8-device virtual CPU mesh, cluster tests on the in-process
+multi-raylet harness.
+"""
+from __future__ import annotations
+
+import os
+
+# Must be set before the first jax backend initialization.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import pytest
+
+
+def _force_cpu_jax():
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+@pytest.fixture(scope="session")
+def jax_cpu():
+    """8 virtual CPU devices for mesh/sharding tests."""
+    _force_cpu_jax()
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"need 8 virtual devices, got {len(devices)}"
+    return jax
+
+
+@pytest.fixture
+def ray_start(request):
+    """Fresh single-node cluster per test; params override init kwargs."""
+    import ray_tpu
+
+    kwargs = getattr(request, "param", {}) or {}
+    kwargs.setdefault("num_cpus", 4)
+    ray_tpu.init(**kwargs)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_cluster():
+    """In-process multi-node cluster harness."""
+    from ray_tpu._private.node import Cluster
+    import ray_tpu
+    from ray_tpu._private.ids import JobID
+    from ray_tpu._private.worker import CoreWorker, set_global_worker
+
+    cluster = Cluster(head_resources={"CPU": 2})
+    job_id = JobID(cluster.head.raylet.gcs.call("next_job_id")["job_id"])
+    core = CoreWorker(
+        mode="driver",
+        gcs_address=cluster.gcs_address,
+        raylet_address=cluster.head.raylet.address,
+        store_socket=cluster.head.store_socket,
+        job_id=job_id,
+        node_id=cluster.head.node_id,
+    )
+    set_global_worker(core)
+    yield cluster
+    core.shutdown()
+    set_global_worker(None)
+    cluster.shutdown()

@@ -1,0 +1,158 @@
+"""Actor API tests (model: reference python/ray/tests/test_actor.py)."""
+import time
+
+import pytest
+
+
+def test_actor_basic(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote(10)
+    assert rt.get(c.incr.remote(), timeout=60) == 11
+    assert rt.get(c.incr.remote(5), timeout=60) == 16
+
+
+def test_actor_method_ordering(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+            return list(self.items)
+
+    a = Appender.remote()
+    refs = [a.append.remote(i) for i in range(20)]
+    final = rt.get(refs[-1], timeout=60)
+    assert final == list(range(20))
+
+
+def test_actor_state_isolation(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    class Holder:
+        def __init__(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    a, b = Holder.remote("a"), Holder.remote("b")
+    assert rt.get([a.get.remote(), b.get.remote()], timeout=120) == ["a", "b"]
+
+
+def test_named_actor(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc").remote()
+    h = rt.get_actor("svc")
+    assert rt.get(h.ping.remote(), timeout=60) == "pong"
+
+
+def test_actor_error(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("method fail")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="method fail"):
+        rt.get(b.fail.remote(), timeout=60)
+    # actor survives a method error
+    assert rt.get(b.ok.remote(), timeout=60) == 1
+
+
+def test_actor_init_failure(ray_start):
+    rt = ray_start
+    from ray_tpu.exceptions import RayTpuError
+
+    @rt.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("bad init")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(Exception):
+        rt.get(b.m.remote(), timeout=60)
+
+
+def test_kill_actor(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert rt.get(v.ping.remote(), timeout=60) == "pong"
+    rt.kill(v)
+    time.sleep(0.5)
+    with pytest.raises(Exception):
+        rt.get(v.ping.remote(), timeout=30)
+
+
+def test_actor_restart(ray_start):
+    rt = ray_start
+
+    @rt.remote(max_restarts=1)
+    class Phoenix:
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "alive"
+
+    p = Phoenix.remote()
+    assert rt.get(p.ping.remote(), timeout=60) == "alive"
+    with pytest.raises(Exception):
+        rt.get(p.crash.remote(), timeout=60)
+    time.sleep(2)
+    assert rt.get(p.ping.remote(), timeout=60) == "alive"
+
+
+def test_handle_serialization(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    class Target:
+        def hello(self):
+            return "hi"
+
+    @rt.remote
+    def call_through(handle):
+        import ray_tpu
+
+        return ray_tpu.get(handle.hello.remote(), timeout=60)
+
+    t = Target.remote()
+    rt.get(t.hello.remote(), timeout=60)  # ensure started
+    assert rt.get(call_through.remote(t), timeout=120) == "hi"

@@ -1,0 +1,104 @@
+"""Multi-node scheduling tests on the in-process cluster harness
+(model: reference python/ray/tests/test_multinode_* via cluster_utils)."""
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_cluster_resources_aggregate(ray_cluster):
+    ray_cluster.add_node(num_cpus=3)
+    time.sleep(0.2)
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 5.0  # 2 head + 3 added
+
+
+def test_tpu_first_class_resource(ray_cluster):
+    ray_cluster.add_node(num_cpus=1, num_tpus=4)
+    time.sleep(0.2)
+    assert ray_tpu.cluster_resources()["TPU"] == 4.0
+
+
+def test_spillback_to_remote_node(ray_cluster):
+    """A task needing TPU must spill from the CPU-only head to the TPU node,
+    and see its assigned chips via TPU_VISIBLE_CHIPS."""
+    ray_cluster.add_node(num_cpus=1, num_tpus=2)
+    time.sleep(1.2)  # allow a heartbeat so the head sees the new node
+
+    @ray_tpu.remote(num_tpus=2, num_cpus=0)
+    def on_tpu():
+        import os
+
+        return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    chips = ray_tpu.get(on_tpu.remote(), timeout=120)
+    assert chips == "0,1"
+
+
+def test_infeasible_task_errors(ray_cluster):
+    @ray_tpu.remote(num_tpus=16)
+    def impossible():
+        return 1
+
+    with pytest.raises(ValueError, match="satisfy"):
+        ray_tpu.get(impossible.remote(), timeout=60)
+
+
+def test_placement_group_strict_spread(ray_cluster):
+    ray_cluster.add_node(num_cpus=2)
+    ray_cluster.add_node(num_cpus=2)
+    time.sleep(1.2)
+    pg = ray_tpu.util.placement_group(
+        [{"CPU": 1}, {"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD"
+    )
+    assert pg.ready(timeout=30)
+    alloc = ray_tpu.worker.global_worker().gcs.call(
+        "get_placement_group", {"pg_id": pg.id.binary()}
+    )["pg"]["allocations"]
+    nodes = {a["node_id"] for a in alloc}
+    assert len(nodes) == 3
+
+
+def test_placement_group_strict_pack_infeasible(ray_cluster):
+    # head has 2 CPU; 3x CPU:1 STRICT_PACK cannot fit on any single node
+    pg = ray_tpu.util.placement_group(
+        [{"CPU": 1}] * 3, strategy="STRICT_PACK"
+    )
+    assert not pg.ready(timeout=2)
+
+
+def test_task_in_placement_group(ray_cluster):
+    import ray_tpu.util as util
+
+    pg = util.placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    def where():
+        return "ran"
+
+    ref = where.options(
+        scheduling_strategy=util.PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        )
+    ).remote()
+    assert ray_tpu.get(ref, timeout=120) == "ran"
+
+
+def test_slice_bundle_lands_on_one_ici_domain(ray_cluster):
+    """TPU gang bundles must co-locate on one ICI domain label."""
+    ray_cluster.add_node(num_cpus=1, num_tpus=4, labels={"ici-domain": "sliceA"})
+    ray_cluster.add_node(num_cpus=1, num_tpus=4, labels={"ici-domain": "sliceA"})
+    ray_cluster.add_node(num_cpus=1, num_tpus=4, labels={"ici-domain": "sliceB"})
+    time.sleep(1.2)
+    pg = ray_tpu.util.slice_bundle(n_hosts=2, chips_per_host=4, cpus_per_host=1)
+    assert pg.ready(timeout=30)
+    alloc = ray_tpu.worker.global_worker().gcs.call(
+        "get_placement_group", {"pg_id": pg.id.binary()}
+    )["pg"]["allocations"]
+    gcs = ray_cluster.head.gcs
+    domains = {
+        gcs.nodes[a["node_id"]]["labels"]["ici-domain"] for a in alloc
+    }
+    assert len(domains) == 1

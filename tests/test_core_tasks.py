@@ -1,0 +1,168 @@
+"""Core task API tests (model: reference python/ray/tests/test_basic.py)."""
+import time
+
+import numpy as np
+import pytest
+
+
+def test_task_roundtrip(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    assert rt.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_chained_dependencies(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    ref = add.remote(1, 2)
+    ref2 = add.remote(ref, 10)
+    ref3 = add.remote(ref2, ref)
+    assert rt.get(ref3, timeout=60) == 16
+
+
+def test_parallel_tasks(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    def square(x):
+        return x * x
+
+    refs = [square.remote(i) for i in range(10)]
+    assert rt.get(refs, timeout=120) == [i * i for i in range(10)]
+
+
+def test_numpy_zero_copy(ray_start):
+    rt = ray_start
+    arr = np.arange(100_000, dtype=np.float32)
+    ref = rt.put(arr)
+    out = rt.get(ref)
+    np.testing.assert_array_equal(out, arr)
+    # out-of-band path: result aliases shared memory, not a pickle copy
+    assert out.base is not None
+
+
+def test_error_propagation(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError, match="kaboom"):
+        rt.get(boom.remote(), timeout=60)
+
+
+def test_error_through_dependency(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    @rt.remote
+    def consume(x):
+        return x
+
+    # the dependency's error surfaces at the consumer's get
+    with pytest.raises(ValueError, match="kaboom"):
+        rt.get(consume.remote(boom.remote()), timeout=60)
+
+
+def test_multiple_returns(ray_start):
+    rt = ray_start
+
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c], timeout=60) == [1, 2, 3]
+
+
+def test_options_override(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    def f():
+        return 42
+
+    ref = f.options(num_cpus=2).remote()
+    assert rt.get(ref, timeout=60) == 42
+
+
+def test_wait(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    def fast():
+        return "fast"
+
+    @rt.remote
+    def slow():
+        time.sleep(30)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = rt.wait([f, s], num_returns=1, timeout=60)
+    assert ready == [f] and not_ready == [s]
+
+
+def test_put_get_roundtrip_types(ray_start):
+    rt = ray_start
+    values = [None, 42, "str", b"bytes", [1, {"a": (2, 3)}], {"k": np.ones(10)}]
+    refs = [rt.put(v) for v in values]
+    out = rt.get(refs)
+    assert out[0] is None and out[1] == 42 and out[2] == "str" and out[3] == b"bytes"
+    assert out[4] == [1, {"a": (2, 3)}]
+    np.testing.assert_array_equal(out[5]["k"], np.ones(10))
+
+
+def test_get_timeout(ray_start):
+    rt = ray_start
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_ref import ObjectRef
+    from ray_tpu.exceptions import GetTimeoutError
+
+    missing = ObjectRef(ObjectID.from_random())
+    with pytest.raises(GetTimeoutError):
+        rt.get(missing, timeout=0.3)
+
+
+def test_task_retry_on_worker_crash(ray_start):
+    rt = ray_start
+    import os
+
+    @rt.remote(max_retries=2)
+    def flaky(marker_path):
+        # crash on first execution, succeed on retry
+        if not os.path.exists(marker_path):
+            open(marker_path, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    marker = f"/tmp/rt_flaky_{os.getpid()}_{time.time()}"
+    assert rt.get(flaky.remote(marker), timeout=120) == "recovered"
+
+
+def test_nested_tasks(ray_start):
+    rt = ray_start
+
+    @rt.remote
+    def inner(x):
+        return x * 2
+
+    @rt.remote
+    def outer(x):
+        import ray_tpu
+
+        return ray_tpu.get(inner.remote(x), timeout=60) + 1
+
+    assert rt.get(outer.remote(10), timeout=120) == 21

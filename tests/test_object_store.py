@@ -1,0 +1,126 @@
+"""Object store daemon + client tests (model: reference plasma tests,
+src/ray/object_manager/test/)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+from ray_tpu._private.object_store import (
+    EVICTED,
+    ObjectStoreClient,
+    build_store_binary,
+    start_store,
+)
+
+
+@pytest.fixture
+def store(tmp_path):
+    sock = str(tmp_path / "store.sock")
+    proc = start_store(sock, 8 * 1024 * 1024)
+    client = ObjectStoreClient(sock)
+    yield client, sock
+    client.shutdown_store()
+    proc.wait(timeout=5)
+
+
+def _oid(i=1):
+    return ObjectID.for_put(TaskID.for_task(JobID.next()), i)
+
+
+def test_create_seal_get(store):
+    client, _ = store
+    oid = _oid()
+    buf = client.create(oid, 5)
+    buf[:] = b"hello"
+    client.seal(oid)
+    assert bytes(client.get(oid, timeout_ms=1000)) == b"hello"
+    assert client.contains(oid)
+
+
+def test_get_missing_returns_none(store):
+    client, _ = store
+    assert client.get(_oid(), timeout_ms=0) is None
+
+
+def test_blocking_get_wakes_on_seal(store):
+    client, sock = store
+    writer = ObjectStoreClient(sock)
+    oid = _oid()
+
+    def write():
+        time.sleep(0.2)
+        b = writer.create(oid, 3)
+        b[:] = b"abc"
+        writer.seal(oid)
+
+    t = threading.Thread(target=write)
+    t.start()
+    assert bytes(client.get(oid, timeout_ms=5000)) == b"abc"
+    t.join()
+
+
+def test_eviction_and_tombstone(store):
+    client, _ = store
+    # fill past capacity with 1MB objects; store is 8MB
+    oids = []
+    for i in range(12):
+        oid = _oid(i + 1)
+        buf = client.create(oid, 1024 * 1024)
+        client.seal(oid)
+        client.release(oid)  # make evictable
+        oids.append(oid)
+    # earliest objects must be gone, reported EVICTED not absent
+    assert client.get(oids[0], timeout_ms=0) is EVICTED
+    # latest object still present
+    assert client.contains(oids[-1])
+
+
+def test_full_when_pinned(store):
+    client, sock = store
+    from ray_tpu.exceptions import ObjectStoreFullError
+
+    # a distinct reader client pins each object server-side (the creator's
+    # own get() serves from its local mapping without pinning)
+    reader = ObjectStoreClient(sock)
+    big = []
+    with pytest.raises(ObjectStoreFullError):
+        for i in range(12):
+            oid = _oid(i + 1)
+            client.create(oid, 1024 * 1024)
+            client.seal(oid)
+            big.append(reader.get(oid))  # hold refs: not evictable
+
+
+def test_serialization_zero_copy(store):
+    client, sock = store
+    oid = _oid()
+    arr = np.arange(50_000, dtype=np.float64)
+    chunks = ser.serialize({"x": arr})
+    buf = client.create(oid, ser.serialized_size(chunks))
+    ser.write_chunks(chunks, buf)
+    client.seal(oid)
+
+    reader = ObjectStoreClient(sock)
+    out = ser.deserialize(reader.get(oid, timeout_ms=1000))
+    np.testing.assert_array_equal(out["x"], arr)
+    assert out["x"].base is not None  # view onto the shm mapping
+
+
+def test_delete(store):
+    client, _ = store
+    oid = _oid()
+    client.create(oid, 4)
+    client.seal(oid)
+    client.release(oid)
+    client.delete(oid)
+    assert client.get(oid, timeout_ms=0) is EVICTED
+
+
+def test_stats(store):
+    client, _ = store
+    s = client.stats()
+    assert s["capacity_bytes"] == 8 * 1024 * 1024
