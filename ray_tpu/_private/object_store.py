@@ -82,6 +82,11 @@ class _Mapping:
 class ObjectStoreClient:
     """Thread-safe client; one socket, one lock (requests are short)."""
 
+    # Max cached mmaps; beyond this the least-recently-used unreferenced
+    # mapping is closed (closed-but-viewed mappings survive via the exported
+    # memoryview's reference to the mmap object).
+    MAX_MAPPINGS = 4096
+
     def __init__(self, socket_path: str):
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         deadline = time.monotonic() + 10
@@ -94,8 +99,11 @@ class ObjectStoreClient:
                     raise
                 time.sleep(0.02)
         self._lock = threading.Lock()
-        # object id -> open mapping, kept while the client holds a reference
-        self._mappings: dict[bytes, _Mapping] = {}
+        # object id -> open mapping; LRU-capped. Guarded by _map_lock.
+        from collections import OrderedDict
+
+        self._mappings: "OrderedDict[bytes, _Mapping]" = OrderedDict()
+        self._map_lock = threading.Lock()
 
     def _request(self, op: int, object_id: bytes, payload: bytes = b"") -> tuple[int, bytes]:
         msg = struct.pack("<IB", 1 + len(object_id) + len(payload), op) + object_id + payload
@@ -129,11 +137,12 @@ class ObjectStoreClient:
             raise RuntimeError(f"create failed: status {st}")
         shm_name = payload.decode()
         if size == 0:
-            self._mappings[object_id.binary()] = _Mapping(memoryview(b""), None)
+            m = _Mapping(memoryview(b""), None)
         else:
             mm = self._map(shm_name, size, writable=True)
-            self._mappings[object_id.binary()] = _Mapping(memoryview(mm), mm)
-        return self._mappings[object_id.binary()].buf
+            m = _Mapping(memoryview(mm), mm)
+        self._cache_mapping(object_id.binary(), m)
+        return m.buf
 
     def seal(self, object_id: ObjectID) -> None:
         st, _ = self._request(OP_SEAL, object_id.binary())
@@ -144,13 +153,13 @@ class ObjectStoreClient:
         """Zero-copy read view, or None if absent (timeout_ms=0 → no wait)."""
         key = object_id.binary()
         # Cache hit: the data is immutable and our mmap stays valid even if
-        # the server evicts the segment (kernel keeps mapped pages), so no
-        # RPC is needed. Exactly one server-side pin is held per client per
-        # object — taken by the first fetching get() below, dropped by
-        # release()/close() — keeping pinned bytes bounded.
-        cached = self._mappings.get(key)
-        if cached is not None:
-            return cached.buf
+        # the server evicts the segment (the kernel keeps mapped pages), so
+        # no RPC is needed.
+        with self._map_lock:
+            cached = self._mappings.get(key)
+            if cached is not None:
+                self._mappings.move_to_end(key)
+                return cached.buf
         st, payload = self._request(OP_GET, key, struct.pack("<Q", timeout_ms))
         if st == ST_NOT_FOUND:
             return None
@@ -162,21 +171,46 @@ class ObjectStoreClient:
             raise RuntimeError(f"get failed: status {st}")
         (size,) = struct.unpack("<Q", payload[:8])
         shm_name = payload[8:].decode()
-        if key in self._mappings:
-            return self._mappings[key].buf
-        if size == 0:
-            self._mappings[key] = _Mapping(memoryview(b""), None)
-        else:
-            mm = self._map(shm_name, size, writable=False)
-            self._mappings[key] = _Mapping(memoryview(mm), mm)
-        return self._mappings[key].buf
+        try:
+            with self._map_lock:
+                if key in self._mappings:
+                    self._mappings.move_to_end(key)
+                    return self._mappings[key].buf
+            if size == 0:
+                m = _Mapping(memoryview(b""), None)
+            else:
+                mm = self._map(shm_name, size, writable=False)
+                m = _Mapping(memoryview(mm), mm)
+            return self._cache_mapping(key, m).buf
+        finally:
+            # Drop the server-side pin taken by OP_GET as soon as the mmap
+            # exists: our mapping keeps the pages valid locally even if the
+            # server evicts, and late readers reconstruct from lineage.
+            # Pinned bytes on the server thus stay transient.
+            self._request(OP_RELEASE, key)
+
+    def _cache_mapping(self, key: bytes, m: _Mapping) -> _Mapping:
+        """Insert-or-get under the lock; loser of a concurrent double-fetch
+        is closed. Returns the canonical mapping for `key`."""
+        with self._map_lock:
+            existing = self._mappings.get(key)
+            if existing is not None:
+                self._mappings.move_to_end(key)
+                m.close()
+                return existing
+            self._mappings[key] = m
+            while len(self._mappings) > self.MAX_MAPPINGS:
+                _, victim = self._mappings.popitem(last=False)
+                victim.close()
+            return m
 
     def release(self, object_id: ObjectID) -> None:
-        key = object_id.binary()
-        m = self._mappings.pop(key, None)
+        """Drop the local mapping. Server pins are transient (taken by
+        OP_GET, dropped as soon as the mmap exists), so no RPC here."""
+        with self._map_lock:
+            m = self._mappings.pop(object_id.binary(), None)
         if m is not None:
             m.close()
-        self._request(OP_RELEASE, key)
 
     def delete(self, object_id: ObjectID) -> None:
         self._request(OP_DELETE, object_id.binary())
@@ -214,9 +248,11 @@ class ObjectStoreClient:
             pass
 
     def close(self) -> None:
-        for m in self._mappings.values():
+        with self._map_lock:
+            mappings = list(self._mappings.values())
+            self._mappings.clear()
+        for m in mappings:
             m.close()
-        self._mappings.clear()
         self._sock.close()
 
     @staticmethod

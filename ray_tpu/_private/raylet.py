@@ -309,10 +309,7 @@ class Raylet:
                 actor["state"] = "DEAD"
                 pending = list(actor["queue"])
                 actor["queue"].clear()
-                # Release the actor's lifetime resources (acquired at creation).
-                creation = actor["creation_spec"]
-                sched.add(self.available, creation["resources"])
-                self._free_chips.extend(actor["assignment"]["chips"])
+                self._return_actor_resources_locked(actor)
             for *_ignore, pspec in pending:
                 self._seal_error(pspec, ActorDiedError(aid.hex(), "actor died"))
             self.gcs.call("update_actor", {"actor_id": aid, "state": "DEAD"})
@@ -547,6 +544,25 @@ class Raylet:
 
     # ------------- actors -------------
 
+    def _return_actor_resources_locked(self, actor: dict) -> None:
+        """Release the actor's lifetime reservation to its origin — PG
+        bundle when placement-group-scheduled, node pool otherwise. Caller
+        holds self._lock; idempotent."""
+        if actor.get("resources_returned"):
+            return
+        actor["resources_returned"] = True
+        creation = actor["creation_spec"]
+        res = creation["resources"]
+        placement = creation.get("placement")
+        if placement is not None:
+            bundle = self._bundles.get(placement["pg"], {}).get(placement["bundle"])
+            if bundle is not None:
+                sched.add(bundle["available"], res)
+        else:
+            sched.add(self.available, res)
+        self._free_chips.extend(actor["assignment"]["chips"])
+        actor["assignment"] = {"chips": []}
+
     def _create_actor(self, spec: dict, assignment: dict) -> None:
         aid = spec["actor_id"]
         with self._lock:
@@ -572,11 +588,29 @@ class Raylet:
 
         def finish_registration():
             if not handle.registered.wait(global_config().worker_register_timeout_s):
+                # worker never connected: reap it, free the reservation,
+                # mark the actor dead
                 self._seal_error(spec, ActorDiedError(aid.hex(), "worker failed to start"))
+                if handle.proc is not None:
+                    handle.proc.terminate()
+                with self._lock:
+                    actor = self._actors.get(aid)
+                    if actor is not None:
+                        actor["state"] = "DEAD"
+                        self._return_actor_resources_locked(actor)
+                self.gcs.call("update_actor", {"actor_id": aid, "state": "DEAD"})
+                with self._dispatch_cv:
+                    self._dispatch_cv.notify_all()
                 return
             with self._lock:
                 actor = self._actors.get(aid)
                 if actor is None:
+                    return
+                if actor["state"] == "DEAD":
+                    # killed while restarting: do not resurrect
+                    if handle.proc is not None:
+                        handle.proc.terminate()
+                    self._return_actor_resources_locked(actor)
                     return
                 actor["worker"] = handle
                 if handle in self._idle_workers:
@@ -631,6 +665,12 @@ class Raylet:
             actor = self._actors.get(aid)
             if actor is None:
                 return {"ok": False}
+            if actor["state"] == "DEAD":
+                # killed while starting/restarting — do not resurrect
+                handle = actor.get("worker")
+                if handle is not None and handle.proc is not None:
+                    handle.proc.terminate()
+                return {"ok": False, "reason": "actor killed"}
             actor["state"] = "ALIVE"
             handle = actor["worker"]
             if handle is not None:
@@ -660,6 +700,10 @@ class Raylet:
             handle = actor["worker"]
             pending = list(actor["queue"])
             actor["queue"].clear()
+            if handle is None:
+                # no live worker (e.g. mid-restart): the disconnect path
+                # won't fire, release the reservation here
+                self._return_actor_resources_locked(actor)
         for *_ignore, pspec in pending:
             self._seal_error(pspec, ActorDiedError(aid.hex(), "actor was killed"))
         if handle is not None and handle.proc is not None:
@@ -704,9 +748,18 @@ class Raylet:
                 buf = self.store.create(oid, size)
                 ser.write_chunks(chunks, buf)
                 self.store.seal(oid)
+            except ValueError:
+                pass  # already exists (duplicate failure path) — keep first
             except Exception:
-                # already exists (e.g. duplicate failure path) — fine
-                pass
+                # e.g. store full: dropping the error would hang the owner's
+                # get() forever — log loudly, it indicates store pressure
+                import traceback
+
+                print(
+                    f"[raylet] FAILED to seal error for task {spec['name']}: "
+                    f"{traceback.format_exc()}",
+                    flush=True,
+                )
 
     # ------------- placement group bundles -------------
 

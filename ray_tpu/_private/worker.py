@@ -64,8 +64,14 @@ class CoreWorker:
         self.raylet = RpcClient(raylet_address, notify_handler=self._on_notify)
         self._put_counter = 0
         self._task_lock = threading.Lock()
-        # lineage: object_id bytes -> creating task spec (owner-side)
-        self._lineage: dict[bytes, dict] = {}
+        # lineage: object_id bytes -> creating task spec (owner-side),
+        # LRU-bounded (reference bounds this via lineage ref-counting,
+        # reference_count.h lineage pinning; here oldest entries age out and
+        # their objects simply become non-reconstructible)
+        from collections import OrderedDict
+
+        self._lineage: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._lineage_cap = 100_000
         self._inflight_resubmits: set[bytes] = set()
         # actor bookkeeping (submitter side)
         self._actor_seqnos: dict[bytes, int] = {}
@@ -98,7 +104,13 @@ class CoreWorker:
     def put_object(self, oid: ObjectID, value: Any) -> None:
         chunks = ser.serialize(value)
         size = ser.serialized_size(chunks)
-        buf = self.store.create(oid, size)
+        try:
+            buf = self.store.create(oid, size)
+        except ValueError:
+            # Already exists: a retried task re-putting under the same
+            # deterministic id (its crashed predecessor sealed it first) —
+            # idempotent success, keep the existing object.
+            return
         ser.write_chunks(chunks, buf)
         self.store.seal(oid)
 
@@ -230,8 +242,11 @@ class CoreWorker:
     def submit_task(self, spec: dict) -> list[ObjectRef]:
         """Submit a normal or actor-creation task to the local raylet."""
         refs = [ObjectRef(o) for o in ts.return_object_ids(spec)]
-        for r in refs:
-            self._lineage[r.object_id.binary()] = spec
+        with self._task_lock:
+            for r in refs:
+                self._lineage[r.object_id.binary()] = spec
+            while len(self._lineage) > self._lineage_cap:
+                self._lineage.popitem(last=False)
         self.raylet.call("submit_task", {"spec": spec})
         return refs
 

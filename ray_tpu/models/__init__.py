@@ -1,0 +1,11 @@
+from ray_tpu.models.gpt import GPTConfig, gpt_forward, gpt_init, gpt_param_axes
+from ray_tpu.models.resnet import ResNet50, resnet_init
+
+__all__ = [
+    "GPTConfig",
+    "gpt_forward",
+    "gpt_init",
+    "gpt_param_axes",
+    "ResNet50",
+    "resnet_init",
+]

@@ -1,0 +1,221 @@
+"""GPT-2 family — the flagship transformer, mesh-parallel from the ground up.
+
+Model config matches GPT-2 125M (BASELINE.json config 3: "JaxTrainer GPT-2
+125M data-parallel"). Written as pure-JAX param pytrees with a parallel
+tree of *logical axis names* so every parallelism strategy in
+ray_tpu/parallel (dp/fsdp/tp/sp) is a rules-table change, not a model
+change. Transformer blocks are stacked and iterated with `lax.scan` —
+one compiled block body regardless of depth (XLA-friendly control flow).
+
+Dtype policy: params f32, activations bf16, loss/softmax f32.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.layers import gelu, layer_norm
+from ray_tpu.parallel.sharding import ShardingRules, with_logical_constraint
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # 50257 padded to a multiple of 128 for the MXU
+    max_seq_len: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_mlp: int = 3072
+    dropout: float = 0.0  # dropout-free by default (modern practice)
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"  # flash | xla | ring
+    remat: bool = False       # jax.checkpoint each block (long-context)
+
+    @staticmethod
+    def gpt2_small() -> "GPTConfig":
+        return GPTConfig()
+
+    @staticmethod
+    def gpt2_medium() -> "GPTConfig":
+        return GPTConfig(n_layer=24, n_head=16, d_model=1024, d_mlp=4096)
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "GPTConfig":
+        """Test-size config for CPU meshes."""
+        return GPTConfig(
+            vocab_size=vocab_size, max_seq_len=128, n_layer=2, n_head=4,
+            d_model=64, d_mlp=256,
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+
+def gpt_init(key: jax.Array, cfg: GPTConfig) -> dict:
+    """Initialize params. Block weights carry a leading n_layer axis (for
+    lax.scan); GPT-2 init: normal(0.02), residual projections scaled by
+    1/sqrt(2*n_layer)."""
+    k = iter(jax.random.split(key, 16))
+    std = 0.02
+    resid_std = std / math.sqrt(2 * cfg.n_layer)
+    L, D, H, M, V, S = (
+        cfg.n_layer, cfg.d_model, cfg.n_head, cfg.d_mlp,
+        cfg.vocab_size, cfg.max_seq_len,
+    )
+
+    def norm(key, *shape, scale=std):
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    return {
+        "wte": norm(next(k), V, D),
+        "wpe": norm(next(k), S, D, scale=std / 2),
+        "blocks": {
+            "ln1_scale": jnp.ones((L, D), jnp.float32),
+            "ln1_bias": jnp.zeros((L, D), jnp.float32),
+            "qkv_w": norm(next(k), L, D, 3 * D),
+            "qkv_b": jnp.zeros((L, 3 * D), jnp.float32),
+            "proj_w": norm(next(k), L, D, D, scale=resid_std),
+            "proj_b": jnp.zeros((L, D), jnp.float32),
+            "ln2_scale": jnp.ones((L, D), jnp.float32),
+            "ln2_bias": jnp.zeros((L, D), jnp.float32),
+            "mlp_in_w": norm(next(k), L, D, M),
+            "mlp_in_b": jnp.zeros((L, M), jnp.float32),
+            "mlp_out_w": norm(next(k), L, M, D, scale=resid_std),
+            "mlp_out_b": jnp.zeros((L, D), jnp.float32),
+        },
+        "ln_f_scale": jnp.ones((D,), jnp.float32),
+        "ln_f_bias": jnp.zeros((D,), jnp.float32),
+    }
+
+
+def gpt_param_axes(cfg: GPTConfig | None = None) -> dict:
+    """Logical axis names, same tree structure as gpt_init's output.
+
+    "embed" maps to fsdp (ZeRO-3 sharding), "mlp"/"heads"/"vocab" to tp —
+    see parallel/sharding.py DEFAULT_RULES. "layer" is never sharded.
+    """
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": {
+            "ln1_scale": (None, "embed"),
+            "ln1_bias": (None, "embed"),
+            "qkv_w": (None, "embed", "mlp"),
+            "qkv_b": (None, "mlp"),
+            "proj_w": (None, "mlp", "embed"),
+            "proj_b": (None, "embed"),
+            "ln2_scale": (None, "embed"),
+            "ln2_bias": (None, "embed"),
+            "mlp_in_w": (None, "embed", "mlp"),
+            "mlp_in_b": (None, "mlp"),
+            "mlp_out_w": (None, "mlp", "embed"),
+            "mlp_out_b": (None, "embed"),
+        },
+        "ln_f_scale": ("embed",),
+        "ln_f_bias": ("embed",),
+    }
+
+
+def _block(x, bp, cfg: GPTConfig, rules: ShardingRules | None, mesh):
+    """One transformer block. x: [B, S, D] in cfg.dtype."""
+    B, S, D = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+
+    def constrain(t, axes):
+        if mesh is None:
+            return t
+        return with_logical_constraint(t, axes, rules, mesh)
+
+    h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+    qkv = (h @ bp["qkv_w"].astype(cfg.dtype)) + bp["qkv_b"].astype(cfg.dtype)
+    q, kk, vv = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    kk = kk.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    vv = vv.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    q = constrain(q, ("batch", "heads", None, None))
+
+    if cfg.attention == "flash":
+        attn = flash_attention(q, kk, vv, causal=True)
+    elif cfg.attention == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+        attn = ring_attention_sharded(q, kk, vv, mesh, causal=True)
+    else:
+        attn = mha_reference(q, kk, vv, causal=True)
+
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
+    x = x + (attn @ bp["proj_w"].astype(cfg.dtype)) + bp["proj_b"].astype(cfg.dtype)
+
+    h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    h = gelu((h @ bp["mlp_in_w"].astype(cfg.dtype)) + bp["mlp_in_b"].astype(cfg.dtype))
+    h = constrain(h, ("batch", "seq", "mlp"))
+    x = x + (h @ bp["mlp_out_w"].astype(cfg.dtype)) + bp["mlp_out_b"].astype(cfg.dtype)
+    return constrain(x, ("batch", "seq", "embed"))
+
+
+def gpt_forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: GPTConfig,
+    *,
+    rules: ShardingRules | None = None,
+    mesh=None,
+) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] (f32)."""
+    B, S = tokens.shape
+    x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(cfg.dtype)[:S]
+    if mesh is not None:
+        # pin the post-gather activation layout; without this SPMD falls back
+        # to full rematerialization when wte is vocab/embed-sharded
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules, mesh)
+
+    def body(x, bp):
+        out = _block(x, bp, cfg, rules, mesh)
+        return out, None
+
+    blocks = params["blocks"]
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, blocks)
+
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    # tied embeddings (GPT-2): output projection = wte^T, f32 logits
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x.astype(jnp.float32), params["wte"].astype(jnp.float32)
+    )
+    return logits
+
+
+def gpt_loss(
+    params: dict,
+    batch: dict,
+    cfg: GPTConfig,
+    *,
+    rules: ShardingRules | None = None,
+    mesh=None,
+) -> jax.Array:
+    """Next-token cross-entropy. batch: {"tokens": [B, S+1]} or
+    {"inputs": [B,S], "targets": [B,S]}."""
+    if "tokens" in batch:
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = gpt_forward(params, inputs, cfg, rules=rules, mesh=mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return -jnp.mean(ll)
+
+
+def gpt_num_params(cfg: GPTConfig) -> int:
+    p = gpt_init(jax.random.PRNGKey(0), cfg)
+    return sum(x.size for x in jax.tree.leaves(p))

@@ -1,0 +1,116 @@
+"""ResNet-50 — the convnet benchmark model (BASELINE.json config 1:
+"DataParallelTrainer ResNet-50"; reference throughput targets in
+BASELINE.md from doc/source/train/benchmarks.rst).
+
+Flax linen implementation, NHWC layout (TPU-native conv layout), bf16
+compute / f32 BatchNorm statistics. v1.5 variant (stride in the 3x3)
+matching torchvision's resnet50 so images/sec comparisons are like-for-like.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = bn()(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides))(y)
+        y = bn()(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1))(y)
+        # zero-init the last BN scale: identity residual at init
+        y = bn(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features * 4, (1, 1), strides=(self.strides, self.strides),
+                name="downsample_conv",
+            )(x)
+            residual = bn(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype, name="conv_init",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=self.dtype, param_dtype=jnp.float32, name="bn_init",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(64 * 2**i, strides=strides, dtype=self.dtype)(
+                    x, train=train
+                )
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+
+
+def resnet_init(key: jax.Array, model: ResNet, image_size: int = 224):
+    variables = model.init(
+        key, jnp.zeros((1, image_size, image_size, 3), jnp.float32), train=True
+    )
+    return variables["params"], variables["batch_stats"]
+
+
+def resnet_loss(params, batch_stats, model, batch, train: bool = True):
+    """Cross-entropy + new batch stats. batch: {'image' NHWC, 'label' int}."""
+    if train:
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"],
+            train=True,
+            mutable=["batch_stats"],
+        )
+        new_stats = mutated["batch_stats"]
+    else:
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"],
+            train=False,
+        )
+        new_stats = batch_stats
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ll = jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
+    acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+    return -jnp.mean(ll), (new_stats, acc)

@@ -1,0 +1,12 @@
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.layers import gelu, layer_norm, rms_norm, rope, rope_cache
+
+__all__ = [
+    "flash_attention",
+    "mha_reference",
+    "gelu",
+    "layer_norm",
+    "rms_norm",
+    "rope",
+    "rope_cache",
+]

@@ -1,0 +1,254 @@
+"""Attention ops: Pallas flash attention (TPU) + XLA reference.
+
+New capability relative to the reference, which has no native attention or
+sequence-parallel kernels at all (SURVEY.md §5.7 — long-context support in
+the reference is delegated to DeepSpeed/FSDP integrations). Design per the
+Pallas TPU guide: online-softmax forward kernel, grid (batch*heads, q_blocks,
+kv_blocks) with the kv axis innermost so VMEM scratch accumulators persist
+across kv steps; backward is flash-recompute via XLA (per-q-block
+re-materialization under `jax.checkpoint`-style recompute — keeps O(S)
+memory for the residuals while XLA fuses the recomputed score matmuls).
+
+The kernel runs in interpret mode on CPU (tests) and compiled on TPU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Plain XLA attention. q,k,v: [B, H, S, D] (kv may have fewer heads =
+    grouped-query; heads must divide)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    q_heads, kv_heads = q.shape[1], k.shape[1]
+    if q_heads != kv_heads:
+        rep = q_heads // kv_heads
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        s_q, s_k = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# ----------------------------------------------------------------------------
+# Pallas forward kernel
+# ----------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref,  # [1, block_q, D], [1, block_kv, D], [1, block_kv, D]
+    o_ref,                # [1, block_q, D]
+    m_scr, l_scr, acc_scr,  # VMEM scratch: [bq,128], [bq,128], [bq,D]
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_kv: int,
+    seq_len: int,
+):
+    from jax.experimental import pallas as pl
+
+    q_idx = pl.program_id(1)
+    kv_idx = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [bq, D]
+        k = k_ref[0].astype(jnp.float32)          # [bkv, D]
+        v = v_ref[0].astype(jnp.float32)          # [bkv, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                  # [bq, bkv]
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0
+            )
+            k_pos = kv_idx * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                      # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [bq, bkv]
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip fully-masked kv blocks above the diagonal
+        @pl.when(kv_idx * block_kv <= q_idx * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q, k, v, *, causal, scale, block_q, block_kv, interpret
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, heads, seq_len, head_dim = q.shape
+    block_q = min(block_q, seq_len)
+    block_kv = min(block_kv, seq_len)
+    if seq_len % block_q or seq_len % block_kv:
+        raise ValueError(
+            f"seq_len {seq_len} must be divisible by block sizes "
+            f"({block_q}, {block_kv})"
+        )
+    bh = batch * heads
+    qf = q.reshape(bh, seq_len, head_dim)
+    kf = k.reshape(bh, seq_len, head_dim)
+    vf = v.reshape(bh, seq_len, head_dim)
+
+    grid = (bh, seq_len // block_q, seq_len // block_kv)
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_kv=block_kv,
+        seq_len=seq_len,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(batch, heads, seq_len, head_dim)
+
+
+# ----------------------------------------------------------------------------
+# custom VJP: pallas forward, XLA flash-recompute backward
+# ----------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash_attention(q, k, v, causal, scale, block_q, block_kv, interpret):
+    return _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+    )
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out = _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+    )
+    return out, (q, k, v, out)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_kv, interpret, res, do):
+    q, k, v, out = res
+    # Flash backward via recompute, in f32. XLA fuses the score recompute
+    # with the gradient matmuls; memory is O(S^2) per (batch, head) shard
+    # here — acceptable at the block sizes the Train layer uses, and the
+    # ring-attention path (ops/ring_attention.py) keeps per-device S small.
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    outf = out.astype(jnp.float32)
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf, preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        s = jnp.where(mask, s, NEG_INF)
+    # lse recomputed here rather than saved by the forward kernel: a 2D lse
+    # output violates Mosaic's (8,128) output-tile constraint, and the
+    # logsumexp falls out of the score recompute for free
+    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - lse)                                # [b,h,q,k]
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(dof * outf, axis=-1, keepdims=True)  # [b,h,q,1]
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 512,
+    block_kv: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Flash attention. q,k,v: [B, H, S, D]; returns [B, H, S, D].
+
+    Grouped-query attention is handled by repeating kv heads up front
+    (cheap relative to attention itself; a head-aware kernel is a later
+    optimization). `interpret` defaults to True off-TPU so tests run the
+    same kernel code on CPU.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[1] != k.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return _flash_attention(q, k, v, causal, scale, block_q, block_kv, interpret)

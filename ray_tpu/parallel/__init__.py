@@ -1,0 +1,25 @@
+from ray_tpu.parallel.mesh import (
+    AxisNames,
+    MeshSpec,
+    build_mesh,
+    local_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_to_mesh_axes,
+    shard_batch_spec,
+    shard_params,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "AxisNames",
+    "MeshSpec",
+    "build_mesh",
+    "local_mesh",
+    "ShardingRules",
+    "logical_to_mesh_axes",
+    "shard_batch_spec",
+    "shard_params",
+    "with_logical_constraint",
+]

@@ -1,0 +1,99 @@
+"""Device meshes: the TPU-native substrate for every parallelism strategy.
+
+The reference has no native TP/PP/SP (SURVEY.md §2.4 — torch DDP/FSDP via
+integrations only; reference: python/ray/train/torch/train_loop_utils.py:74
+prepare_model→DDP/FSDP). Here parallelism is mesh-first: a single
+`jax.sharding.Mesh` with canonical axis names carries data/fsdp/tensor/
+sequence/pipeline/expert parallelism; XLA inserts the collectives over
+ICI/DCN (the NCCL replacement per SURVEY.md §5.8).
+
+Axis order is chosen so the innermost (fastest-varying, ICI-nearest) axis is
+tensor parallelism — TP collectives are latency-bound and must ride the
+shortest ICI hops; DP/FSDP gradient reductions are bandwidth-bound and
+tolerate the outer axes (DCN across slices in multi-slice deployments).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+class AxisNames:
+    DATA = "dp"       # pure data parallel (replicated params)
+    FSDP = "fsdp"     # sharded-data-parallel (ZeRO-3 style param sharding)
+    TENSOR = "tp"     # tensor/model parallel
+    SEQ = "sp"        # sequence/context parallel (ring attention)
+    PIPE = "pp"       # pipeline stages
+    EXPERT = "ep"     # MoE expert parallel
+
+    ALL = (DATA, FSDP, PIPE, SEQ, TENSOR, EXPERT)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical axis sizes; -1 on at most one axis means 'fill remaining'."""
+
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {
+            AxisNames.DATA: self.dp,
+            AxisNames.FSDP: self.fsdp,
+            AxisNames.PIPE: self.pp,
+            AxisNames.SEQ: self.sp,
+            AxisNames.TENSOR: self.tp,
+            AxisNames.EXPERT: self.ep,
+        }
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = self.sizes()
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh axes product {fixed} != device count {n_devices}"
+            )
+        return MeshSpec(
+            dp=sizes[AxisNames.DATA],
+            fsdp=sizes[AxisNames.FSDP],
+            pp=sizes[AxisNames.PIPE],
+            sp=sizes[AxisNames.SEQ],
+            tp=sizes[AxisNames.TENSOR],
+            ep=sizes[AxisNames.EXPERT],
+        )
+
+
+def build_mesh(spec: MeshSpec, devices=None):
+    """Build a Mesh with the canonical 6 named axes (size-1 axes included —
+    they cost nothing and keep sharding specs uniform)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolve(len(devices))
+    sizes = spec.sizes()
+    shape = tuple(sizes[a] for a in AxisNames.ALL)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AxisNames.ALL)
+
+
+def local_mesh(**axis_sizes):
+    """Convenience: mesh over all local devices, e.g. local_mesh(dp=-1) or
+    local_mesh(dp=2, tp=4)."""
+    spec = MeshSpec(**axis_sizes) if axis_sizes else MeshSpec(dp=-1)
+    return build_mesh(spec)

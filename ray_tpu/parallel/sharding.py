@@ -1,0 +1,128 @@
+"""Logical-axis sharding rules: params/activations → mesh axes.
+
+The TPU replacement for the reference's wrapper-based parallelism
+(reference: train/torch/train_loop_utils.py:74-95 prepare_model wraps
+DDP/FSDP around an opaque module). Here models annotate every parameter
+with *logical* axis names ("embed", "heads", "mlp", ...); a ShardingRules
+table maps logical axes to mesh axes, and `shard_params` materializes
+`NamedSharding`s. Changing the parallelism strategy = changing the rules
+table — the model code never changes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import AxisNames
+
+
+# Default logical→mesh rules for transformer-family models.
+# fsdp shards the embed (model-dim) axis of every weight — ZeRO-3;
+# tp shards heads / mlp-hidden / vocab — Megatron-style.
+DEFAULT_RULES: tuple[tuple[str, str | tuple[str, ...] | None], ...] = (
+    ("batch", (AxisNames.DATA, AxisNames.FSDP)),
+    ("seq", AxisNames.SEQ),
+    ("embed", AxisNames.FSDP),
+    ("heads", AxisNames.TENSOR),
+    ("kv_heads", AxisNames.TENSOR),
+    ("mlp", AxisNames.TENSOR),
+    ("vocab", AxisNames.TENSOR),
+    ("head_dim", None),
+    ("expert", AxisNames.EXPERT),
+    ("stage", AxisNames.PIPE),
+    ("conv_kernel", None),
+    ("channels_in", None),
+    ("channels_out", AxisNames.TENSOR),
+)
+
+
+@dataclass
+class ShardingRules:
+    rules: tuple[tuple[str, Any], ...] = DEFAULT_RULES
+
+    def mesh_axes(self, logical_axes: tuple[str | None, ...]) -> P:
+        table = dict(self.rules)
+        out = []
+        used: set[str] = set()
+        for ax in logical_axes:
+            mapped = table.get(ax) if ax is not None else None
+            # drop mesh axes already consumed by an earlier dim (a mesh axis
+            # may shard at most one dim of a given array)
+            if isinstance(mapped, tuple):
+                mapped = tuple(m for m in mapped if m not in used) or None
+                if mapped is not None:
+                    used.update(mapped)
+            elif mapped is not None:
+                if mapped in used:
+                    mapped = None
+                else:
+                    used.add(mapped)
+            out.append(mapped)
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def replace(self, **overrides) -> "ShardingRules":
+        new_rules = tuple(
+            (k, overrides.get(k, v)) for k, v in self.rules
+        ) + tuple((k, v) for k, v in overrides.items() if k not in dict(self.rules))
+        return ShardingRules(new_rules)
+
+
+def logical_to_mesh_axes(
+    axes_tree: Any, rules: ShardingRules | None = None
+) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    rules = rules or ShardingRules()
+    return jax.tree.map(
+        lambda axes: rules.mesh_axes(axes),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )
+
+
+def shard_params(params: Any, axes_tree: Any, mesh: Mesh,
+                 rules: ShardingRules | None = None) -> Any:
+    """Device-put a param pytree with NamedShardings derived from its
+    logical axes. Arrays already on-mesh are resharded lazily by XLA."""
+    specs = logical_to_mesh_axes(axes_tree, rules)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params,
+        specs,
+    )
+
+
+def param_shardings(axes_tree: Any, mesh: Mesh,
+                    rules: ShardingRules | None = None) -> Any:
+    """NamedSharding pytree (for jit in_shardings/out_shardings)."""
+    specs = logical_to_mesh_axes(axes_tree, rules)
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_batch_spec(rules: ShardingRules | None = None, *, seq_sharded: bool = False) -> P:
+    """PartitionSpec for [batch, seq, ...] input batches."""
+    rules = rules or ShardingRules()
+    if seq_sharded:
+        return rules.mesh_axes(("batch", "seq"))
+    return rules.mesh_axes(("batch", None))
+
+
+def with_logical_constraint(x, logical_axes: tuple[str | None, ...],
+                            rules: ShardingRules | None = None,
+                            mesh: Mesh | None = None):
+    """Annotate an intermediate activation inside jit (the
+    lax.with_sharding_constraint idiom keyed by logical axes). With an
+    explicit mesh a NamedSharding is used; otherwise the caller must be
+    under a mesh context (jax.sharding.use_mesh)."""
+    rules = rules or ShardingRules()
+    spec = rules.mesh_axes(logical_axes)
+    if mesh is not None:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return jax.lax.with_sharding_constraint(x, spec)

@@ -1,0 +1,24 @@
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import get_context, report
+from ray_tpu.train.trainer import JaxTrainer, Result
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "WorkerGroup",
+    "get_context",
+    "report",
+]

@@ -1,0 +1,119 @@
+"""Checkpoints: directory-based handles + Orbax-backed array state.
+
+Equivalent of the reference's Checkpoint abstraction
+(reference: python/ray/train/_checkpoint.py:55 — a directory/URI handle,
+from_directory:158/to_directory:169; CheckpointManager top-k retention in
+train/_internal/checkpoint_manager.py). TPU-native persistence: sharded
+JAX pytrees go through Orbax (ocdbt), so each mesh host writes its own
+shards — the multi-host-safe path the reference delegates to torch.save.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Optional
+
+
+class Checkpoint:
+    """Handle to an on-disk checkpoint directory."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: str) -> str:
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # ---- JAX state helpers (Orbax) ----
+
+    @classmethod
+    def from_state(cls, path: str, state: Any, *, force: bool = True) -> "Checkpoint":
+        """Save a pytree of (possibly sharded) arrays with Orbax."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(path, "state"), state, force=force)
+        ckptr.wait_until_finished()
+        return cls(path)
+
+    def load_state(self, target: Any = None) -> Any:
+        """Restore the pytree. With `target` (abstract/concrete arrays with
+        shardings) arrays restore onto those devices; without, arrays come
+        back as host numpy — device-agnostic, so a checkpoint written by a
+        CPU-mesh worker restores fine in a TPU driver and vice versa."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.join(self.path, "state")
+        if target is not None:
+            return ocp.StandardCheckpointer().restore(path, target)
+        import numpy as np
+        import jax
+
+        ckptr = ocp.PyTreeCheckpointer()
+        meta = ckptr.metadata(path)
+        tree = meta.item_metadata.tree if hasattr(meta, "item_metadata") else meta.tree
+        restore_args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray),
+            tree,
+            is_leaf=lambda x: hasattr(x, "shape"),
+        )
+        return ckptr.restore(path, restore_args=restore_args)
+
+    def write_metadata(self, meta: dict) -> None:
+        with open(os.path.join(self.path, "metadata.json"), "w") as f:
+            json.dump(meta, f)
+
+    def read_metadata(self) -> dict:
+        p = os.path.join(self.path, "metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Top-k retention scored by a metric (reference:
+    train/_internal/checkpoint_manager.py; CheckpointConfig air/config.py:574)."""
+
+    def __init__(self, *, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._entries: list[tuple[float, str]] = []  # (score, path)
+
+    def register(self, checkpoint_path: str, metrics: dict) -> None:
+        if self.score_attribute and self.score_attribute in metrics:
+            score = float(metrics[self.score_attribute])
+        else:
+            score = float(len(self._entries))  # fallback: recency
+        self._entries.append((score, checkpoint_path))
+        if self.num_to_keep is None or len(self._entries) <= self.num_to_keep:
+            return
+        reverse = self.score_order == "max"
+        self._entries.sort(key=lambda e: e[0], reverse=reverse)
+        while len(self._entries) > self.num_to_keep:
+            _, victim = self._entries.pop()
+            shutil.rmtree(victim, ignore_errors=True)
+
+    def best(self) -> Optional[str]:
+        if not self._entries:
+            return None
+        reverse = self.score_order == "max"
+        return sorted(self._entries, key=lambda e: e[0], reverse=reverse)[0][1]
+
+    def latest(self) -> Optional[str]:
+        return self._entries[-1][1] if self._entries else None

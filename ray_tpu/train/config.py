@@ -1,0 +1,59 @@
+"""Train-layer configs.
+
+Equivalent of the reference's AIR config surface
+(reference: python/ray/air/config.py — ScalingConfig:94, RunConfig:723,
+CheckpointConfig:574, FailureConfig:523). TPU-first: ScalingConfig speaks
+chips and slice topology instead of GPUs.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 1          # TPU chips reserved per worker
+    cpus_per_worker: float = 1.0
+    resources_per_worker: dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "PACK"   # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    slice_aligned: bool = True         # keep the gang on one ICI domain
+    # Initialize jax.distributed across the gang (multi-host pods). Off by
+    # default: on a single host, N worker processes must not contend for
+    # the same local chips.
+    jax_distributed: bool = False
+
+    def worker_resources(self) -> dict[str, float]:
+        res = {"CPU": float(self.cpus_per_worker)}
+        if self.use_tpu:
+            res["TPU"] = float(self.chips_per_worker)
+        res.update(self.resources_per_worker)
+        return res
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None          # None = keep all
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"        # max | min
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # gang restarts before giving up
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None  # default ~/ray_tpu_results
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "run"
+        return os.path.join(base, name)

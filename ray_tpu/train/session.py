@@ -1,0 +1,98 @@
+"""Per-worker training session: report(), context, checkpoint plumbing.
+
+Equivalent of the reference's _TrainSession
+(reference: python/ray/train/_internal/session.py:132 — report at :844→:612
+streams metrics+checkpoint through a queue back to the trainer). Here the
+session buffers reports in the worker actor; the trainer polls them via an
+actor method (our actors execute methods serially, so the user train loop
+runs on a background thread and polling stays responsive).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class TrainContext:
+    world_size: int = 1
+    world_rank: int = 0
+    local_rank: int = 0
+    node_rank: int = 0
+    trial_name: str = ""
+    storage_path: str = ""
+    trial_dir: str = ""
+    experiment_config: dict = field(default_factory=dict)
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+    def mesh(self, **axis_sizes):
+        """Mesh over the gang's global devices (all local in single-host;
+        global across processes once jax.distributed is initialized)."""
+        from ray_tpu.parallel import local_mesh
+
+        return local_mesh(**axis_sizes)
+
+
+class _Session:
+    def __init__(self, context: TrainContext):
+        self.context = context
+        self._lock = threading.Lock()
+        self._reports: list[dict] = []
+        self._done = False
+        self._error: str | None = None
+
+    def report(self, metrics: dict, checkpoint=None) -> None:
+        entry = {"metrics": dict(metrics)}
+        if checkpoint is not None:
+            entry["checkpoint_path"] = checkpoint.path
+        with self._lock:
+            self._reports.append(entry)
+
+    def drain(self, since: int) -> tuple[list[dict], bool, str | None]:
+        with self._lock:
+            return self._reports[since:], self._done, self._error
+
+    def finish(self, error: str | None = None) -> None:
+        with self._lock:
+            self._done = True
+            self._error = error
+
+
+_session: _Session | None = None
+
+
+def init_session(context: TrainContext) -> _Session:
+    global _session
+    _session = _Session(context)
+    return _session
+
+
+def get_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active — are you inside train_loop_per_worker?"
+        )
+    return _session
+
+
+def report(metrics: dict, *, checkpoint=None) -> None:
+    """Stream metrics (and optionally a checkpoint) to the trainer
+    (reference: ray.train.report)."""
+    get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return get_session().context

@@ -1,0 +1,49 @@
+"""Mesh + sharding rules tests."""
+import pytest
+
+
+def test_mesh_spec_resolve():
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    assert MeshSpec(dp=-1).resolve(8).dp == 8
+    s = MeshSpec(dp=-1, tp=2).resolve(8)
+    assert s.dp == 4 and s.tp == 2
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=-1).resolve(8)
+
+
+def test_build_mesh(jax_cpu):
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dp=2, tp=4))
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+    assert mesh.devices.size == 8
+
+
+def test_sharding_rules_mapping():
+    from jax.sharding import PartitionSpec as P
+    from ray_tpu.parallel.sharding import ShardingRules
+
+    rules = ShardingRules()
+    assert rules.mesh_axes(("batch", None)) == P(("dp", "fsdp"))
+    assert rules.mesh_axes(("vocab", "embed")) == P("tp", "fsdp")
+    assert rules.mesh_axes((None, "embed", "mlp")) == P(None, "fsdp", "tp")
+    # duplicate mesh axis consumed once only
+    assert rules.mesh_axes(("heads", "mlp")) == P("tp")
+    # trailing Nones trimmed
+    assert rules.mesh_axes(("embed", "head_dim")) == P("fsdp")
+
+
+def test_shard_params_places_on_mesh(jax_cpu):
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.parallel import MeshSpec, build_mesh, shard_params
+
+    mesh = build_mesh(MeshSpec(fsdp=2, tp=4))
+    params = {"w": jnp.zeros((8, 16)), "b": jnp.zeros((16,))}
+    axes = {"w": ("embed", "mlp"), "b": ("mlp",)}
+    sharded = shard_params(params, axes, mesh)
+    spec_w = sharded["w"].sharding.spec
+    assert tuple(spec_w) == ("fsdp", "tp")

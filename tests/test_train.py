@@ -1,0 +1,129 @@
+"""Train layer: JaxTrainer end-to-end (model: reference
+python/ray/train/tests/test_data_parallel_trainer.py)."""
+import os
+import tempfile
+
+import pytest
+
+
+def test_trainer_metrics_streaming(ray_start):
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig, get_context, report
+
+    def train_fn(config):
+        ctx = get_context()
+        for step in range(3):
+            report({"step": step, "loss": 1.0 / (step + 1), "rank": ctx.get_world_rank()})
+
+    result = JaxTrainer(
+        train_fn,
+        train_loop_config={"lr": 0.1},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="stream", storage_path=tempfile.mkdtemp()),
+    ).fit()
+    assert result.error is None
+    assert len(result.metrics_history) == 3
+    assert result.metrics["step"] == 2
+
+
+def test_trainer_real_training_with_checkpoint(ray_start):
+    from ray_tpu.train import (
+        CheckpointConfig, JaxTrainer, RunConfig, ScalingConfig,
+    )
+
+    storage = tempfile.mkdtemp()
+
+    def train_fn(config):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+        from ray_tpu.train import Checkpoint, get_context, report
+
+        cfg = GPTConfig.tiny(vocab_size=128)
+        params = gpt_init(jax.random.PRNGKey(0), cfg)
+        tx = optax.adamw(1e-3)
+        opt_state = tx.init(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, 128)
+
+        @jax.jit
+        def step(params, opt_state):
+            loss, grads = jax.value_and_grad(gpt_loss)(
+                params, {"tokens": tokens}, cfg
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        ctx = get_context()
+        for i in range(3):
+            params, opt_state, loss = step(params, opt_state)
+            ckpt_dir = os.path.join(ctx.get_trial_dir(), f"ckpt_{i}")
+            ckpt = Checkpoint.from_state(ckpt_dir, params)
+            ckpt.write_metadata({"step": i})
+            report({"loss": float(loss), "step": i}, checkpoint=ckpt)
+
+    result = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="gpt_tiny",
+            storage_path=storage,
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="loss",
+                checkpoint_score_order="min",
+            ),
+        ),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.checkpoint is not None
+    # restore
+    state = result.checkpoint.load_state()
+    assert "wte" in state
+    # losses decreased
+    losses = [m["loss"] for m in result.metrics_history]
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_worker_error_surfaces(ray_start):
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig, report
+
+    def train_fn(config):
+        report({"step": 0})
+        raise RuntimeError("train exploded")
+
+    result = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="boom", storage_path=tempfile.mkdtemp()),
+    ).fit()
+    assert result.error is not None and "train exploded" in result.error
+
+
+def test_trainer_gang_restart_on_failure(ray_start):
+    from ray_tpu.train import (
+        FailureConfig, JaxTrainer, RunConfig, ScalingConfig, get_context, report,
+    )
+
+    marker = tempfile.mktemp()
+
+    def train_fn(config):
+        import os
+
+        if not os.path.exists(config["marker"]):
+            open(config["marker"], "w").close()
+            raise RuntimeError("first attempt dies")
+        report({"recovered": 1})
+
+    result = JaxTrainer(
+        train_fn,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="restart",
+            storage_path=tempfile.mkdtemp(),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["recovered"] == 1
