@@ -751,6 +751,8 @@ class Raylet:
             except ValueError:
                 pass  # already exists (duplicate failure path) — keep first
             except Exception:
+                if self._stopped.is_set():
+                    return  # store already torn down; nobody will get() this
                 # e.g. store full: dropping the error would hang the owner's
                 # get() forever — log loudly, it indicates store pressure
                 import traceback

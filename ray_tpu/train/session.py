@@ -46,18 +46,17 @@ class TrainContext:
         return local_mesh(**axis_sizes)
 
 
-class _Session:
-    def __init__(self, context: TrainContext):
-        self.context = context
+class ReportBuffer:
+    """Thread-safe report queue shared by the train and tune sessions: the
+    user loop appends on its thread, the controller drains via actor polls."""
+
+    def __init__(self):
         self._lock = threading.Lock()
         self._reports: list[dict] = []
         self._done = False
         self._error: str | None = None
 
-    def report(self, metrics: dict, checkpoint=None) -> None:
-        entry = {"metrics": dict(metrics)}
-        if checkpoint is not None:
-            entry["checkpoint_path"] = checkpoint.path
+    def append(self, entry: dict) -> None:
         with self._lock:
             self._reports.append(entry)
 
@@ -69,6 +68,18 @@ class _Session:
         with self._lock:
             self._done = True
             self._error = error
+
+
+class _Session(ReportBuffer):
+    def __init__(self, context: TrainContext):
+        super().__init__()
+        self.context = context
+
+    def report(self, metrics: dict, checkpoint=None) -> None:
+        entry = {"metrics": dict(metrics)}
+        if checkpoint is not None:
+            entry["checkpoint_path"] = checkpoint.path
+        self.append(entry)
 
 
 _session: _Session | None = None

@@ -1,0 +1,57 @@
+"""ray_tpu.tune — hyperparameter search over trial actors.
+
+Equivalent of the reference's Tune (reference: python/ray/tune — Tuner
+tuner.py:59, TuneController execution/tune_controller.py:81, schedulers/,
+search/). Trials are actors on the distributed core; TPU trials reserve
+chips via trial resources so concurrent trials never share a chip.
+"""
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.session import get_checkpoint, report
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    TuneConfig,
+    TuneResult,
+    TuneRunConfig,
+    Tuner,
+)
+
+__all__ = [
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "Trial",
+    "TrialScheduler",
+    "TuneConfig",
+    "TuneResult",
+    "TuneRunConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "sample_from",
+    "uniform",
+]

@@ -1,0 +1,200 @@
+"""Trial schedulers: FIFO, ASHA, median-stopping, PBT.
+
+Equivalent of the reference's scheduler suite (reference: python/ray/tune/
+schedulers/ — ASHA async_hyperband.py:19, PBT pbt.py:222, median stopping
+median_stopping_rule.py). Schedulers see every reported result and return
+CONTINUE/STOP; PBT additionally rewrites config + restore checkpoint on
+exploit.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional
+
+from ray_tpu.tune.trial import Trial
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_search_properties(self, metric: str, mode: str) -> None:
+        self.metric, self.mode = metric, mode
+
+    def _score(self, result: dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial: Trial) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class _Bracket:
+    """One ASHA rung ladder: milestones at grace * rf**k, each rung records
+    one score per trial (its score when it first reaches the rung)."""
+
+    def __init__(self, grace_period: float, rf: float, max_t: float):
+        # rung milestone -> {trial_id: score at crossing}
+        self.rungs: Dict[float, Dict[str, float]] = {}
+        m = grace_period
+        while m < max_t:
+            self.rungs[m] = {}
+            m = m * rf
+
+    def on_result(self, trial_id: str, t: float, score: float, rf: float) -> str:
+        for milestone in sorted(self.rungs, reverse=True):
+            if t < milestone:
+                continue
+            recorded = self.rungs[milestone]
+            if trial_id in recorded:
+                break  # already judged at this rung
+            action = CONTINUE
+            if recorded:
+                # cutoff = top 1/rf quantile of per-trial crossing scores
+                vals = sorted(recorded.values(), reverse=True)
+                cutoff = vals[max(0, int(len(vals) / rf) - 1)]
+                if score < cutoff:
+                    action = STOP
+            recorded[trial_id] = score
+            return action
+        return CONTINUE
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: tune/schedulers/async_hyperband.py:19): rungs at
+    grace_period * reduction_factor**k; a trial crossing a rung stops unless
+    its crossing score is in the top 1/reduction_factor of the per-trial
+    scores recorded at that rung. Multiple brackets stagger grace periods
+    (bracket s starts at grace * rf**s); trials are assigned round-robin."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: float = 3,
+                 max_t: int = 100, brackets: int = 1):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self._brackets = [
+            _Bracket(grace_period * reduction_factor ** s, reduction_factor, max_t)
+            for s in range(max(1, brackets))
+        ]
+        self._trial_bracket: Dict[str, _Bracket] = {}
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        t = result.get(self.time_attr, trial.iteration)
+        if t >= self.max_t:
+            return STOP
+        bracket = self._trial_bracket.setdefault(
+            trial.trial_id,
+            self._brackets[len(self._trial_bracket) % len(self._brackets)],
+        )
+        return bracket.on_result(trial.trial_id, t, self._score(result), self.rf)
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score is below the median of the running
+    averages of completed results (reference: tune/schedulers/
+    median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._avgs: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        scores = self._avgs.setdefault(trial.trial_id, [])
+        scores.append(self._score(result))
+        t = result.get(self.time_attr, trial.iteration)
+        if t < self.grace_period:
+            return CONTINUE
+        others = [sum(v) / len(v) for k, v in self._avgs.items()
+                  if k != trial.trial_id and v]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        median = sorted(others)[len(others) // 2]
+        best = max(scores)
+        return STOP if best < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py:222): every
+    perturbation_interval, bottom-quantile trials clone the checkpoint of a
+    top-quantile trial (exploit) and perturb its hyperparameters (explore).
+    The controller applies the returned decision by restarting the trial
+    actor with trial.config / trial.restore_path updated in place."""
+
+    EXPLOIT = "EXPLOIT"  # internal decision: controller restarts the trial
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: int | None = None):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.rng = random.Random(seed)
+        self._last_perturb: Dict[str, float] = {}
+        # trial_id -> (score, checkpoint_path, config) at last report
+        self._state: Dict[str, tuple] = {}
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        score = self._score(result)
+        self._state[trial.trial_id] = (score, trial.checkpoint_path, dict(trial.config))
+        t = result.get(self.time_attr, trial.iteration)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+
+        ranked = sorted(self._state.items(), key=lambda kv: kv[1][0])
+        n = len(ranked)
+        if n < 2:
+            return CONTINUE
+        k = max(1, int(n * self.quantile))
+        bottom = [tid for tid, _ in ranked[:k]]
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id not in bottom or trial.trial_id in top:
+            return CONTINUE
+        donor_id = self.rng.choice(top)
+        donor_score, donor_ckpt, donor_cfg = self._state[donor_id]
+        if donor_ckpt is None:
+            return CONTINUE
+        trial.config = self._explore(donor_cfg)
+        trial.restore_path = donor_ckpt
+        return self.EXPLOIT
+
+    def _explore(self, config: dict) -> dict:
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in new:
+                continue
+            if callable(spec):
+                new[key] = spec()
+            elif isinstance(spec, list):
+                if self.rng.random() < self.resample_prob or new[key] not in spec:
+                    new[key] = self.rng.choice(spec)
+                else:
+                    i = spec.index(new[key])
+                    i = min(len(spec) - 1, max(0, i + self.rng.choice([-1, 1])))
+                    new[key] = spec[i]
+            elif isinstance(spec, dict) and "lower" in spec:
+                lo, hi = spec["lower"], spec["upper"]
+                if self.rng.random() < self.resample_prob:
+                    new[key] = self.rng.uniform(lo, hi)
+                else:
+                    new[key] = min(hi, max(lo, new[key] * self.rng.choice([0.8, 1.2])))
+        return new

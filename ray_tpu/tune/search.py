@@ -1,0 +1,167 @@
+"""Search spaces + trial-config generators.
+
+Equivalent of the reference's sample-space API and BasicVariantGenerator
+(reference: python/ray/tune/search/sample.py — uniform/loguniform/choice/
+randint/grid_search domains; python/ray/tune/search/basic_variant.py —
+grid/random variant expansion). Custom searchers plug in via the Searcher
+interface (reference: python/ray/tune/search/searcher.py).
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Iterator
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        if low <= 0 or high <= 0:
+            raise ValueError("loguniform bounds must be positive")
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class Randint(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable[[dict], Any]):
+        self.fn = fn
+
+    def sample(self, rng):  # resolved against the spec later
+        raise TypeError("SampleFrom is resolved with the config, not the rng")
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def sample_from(fn: Callable[[dict], Any]) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def _split_spec(spec: dict, prefix=()) -> tuple[list, list]:
+    """Walk the (possibly nested) param space → (grid_items, other_items)
+    where each item is (key_path, domain_or_value)."""
+    grids, others = [], []
+    for k, v in spec.items():
+        path = prefix + (k,)
+        if isinstance(v, GridSearch):
+            grids.append((path, v))
+        elif isinstance(v, dict):
+            g, o = _split_spec(v, path)
+            grids.extend(g)
+            others.extend(o)
+        else:
+            others.append((path, v))
+    return grids, others
+
+
+def _set_path(cfg: dict, path: tuple, value: Any) -> None:
+    for k in path[:-1]:
+        cfg = cfg.setdefault(k, {})
+    cfg[path[-1]] = value
+
+
+class Searcher:
+    """Pluggable suggestion interface (reference: tune/search/searcher.py).
+    Subclasses implement suggest() and optionally on_trial_complete()."""
+
+    def set_search_properties(self, metric: str | None, mode: str | None) -> None:
+        self.metric, self.mode = metric, mode
+
+    def suggest(self, trial_id: str) -> dict | None:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid x random expansion: the cross-product of all grid_search values,
+    repeated num_samples times with random domains re-sampled per repeat."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1, seed: int | None = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._iter = self._generate()
+
+    def _generate(self) -> Iterator[dict]:
+        grids, others = _split_spec(self.param_space)
+        grid_paths = [p for p, _ in grids]
+        grid_values = [g.values for _, g in grids]
+        combos = list(itertools.product(*grid_values)) if grids else [()]
+        for _ in range(self.num_samples):
+            for combo in combos:
+                cfg: dict = {}
+                for path, val in zip(grid_paths, combo):
+                    _set_path(cfg, path, val)
+                deferred = []
+                for path, v in others:
+                    if isinstance(v, Domain):
+                        if isinstance(v, SampleFrom):
+                            deferred.append((path, v))
+                        else:
+                            _set_path(cfg, path, v.sample(self.rng))
+                    else:
+                        _set_path(cfg, path, v)
+                for path, v in deferred:
+                    _set_path(cfg, path, v.fn(cfg))
+                yield cfg
+
+    def suggest(self, trial_id: str) -> dict | None:
+        return next(self._iter, None)

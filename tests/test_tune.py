@@ -1,0 +1,249 @@
+"""Tune layer: grid/random search, ASHA early stopping, PBT, resume
+(model: reference python/ray/tune/tests/test_tune_*.py, test_trial_scheduler*.py)."""
+import os
+import tempfile
+
+import pytest
+
+
+def test_grid_search_runs_all_variants(ray_start):
+    from ray_tpu import tune
+
+    def trainable(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"a": tune.grid_search([1, 2, 3]),
+                     "b": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=tune.TuneRunConfig(storage_path=tempfile.mkdtemp()),
+    ).fit()
+    assert len(results) == 6
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.metrics["score"] == 31
+    assert best.config == {"a": 3, "b": 1}
+
+
+def test_random_search_samples_domains(ray_start):
+    from ray_tpu import tune
+
+    def trainable(config):
+        tune.report({"v": config["lr"]})
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1),
+                     "wd": tune.uniform(0, 1),
+                     "layers": tune.randint(1, 5),
+                     "act": tune.choice(["relu", "gelu"]),
+                     "twice_lr": tune.sample_from(lambda cfg: cfg["lr"] * 2)},
+        tune_config=tune.TuneConfig(metric="v", mode="min", num_samples=4, seed=0),
+        run_config=tune.TuneRunConfig(storage_path=tempfile.mkdtemp()),
+    ).fit()
+    assert len(results) == 4
+    for r in results:
+        assert 1e-4 <= r.config["lr"] <= 1e-1
+        assert r.config["act"] in ("relu", "gelu")
+        assert r.config["twice_lr"] == pytest.approx(r.config["lr"] * 2)
+
+
+def test_asha_stops_bad_trials_early(ray_start):
+    from ray_tpu import tune
+
+    def trainable(config):
+        import time
+
+        for i in range(20):
+            tune.report({"acc": config["q"] * (i + 1)})
+            time.sleep(0.05)  # pace so trials progress concurrently
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.01, 0.02, 1.0, 2.0])},
+        tune_config=tune.TuneConfig(
+            metric="acc", mode="max",
+            scheduler=tune.AsyncHyperBandScheduler(
+                grace_period=2, reduction_factor=2, max_t=20),
+            max_concurrent_trials=4,
+        ),
+        run_config=tune.TuneRunConfig(storage_path=tempfile.mkdtemp()),
+    ).fit()
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.config["q"] == 2.0
+    # at least one weak trial must have been stopped before 20 iterations
+    iters = [r.metrics.get("training_iteration", 0) for r in results]
+    assert min(iters) < 20
+
+
+def test_trial_failure_and_max_failures_retry(ray_start):
+    from ray_tpu import tune
+
+    def flaky(config):
+        d = config["dir"]
+        marker = os.path.join(d, "attempt")
+        n = len(os.listdir(d))
+        open(os.path.join(d, f"a{n}"), "w").close()
+        if n == 0:
+            raise RuntimeError("boom")
+        tune.report({"ok": 1})
+
+    d = tempfile.mkdtemp()
+    results = tune.Tuner(
+        flaky,
+        param_space={"dir": d},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+        run_config=tune.TuneRunConfig(storage_path=tempfile.mkdtemp(),
+                                      max_failures=1),
+    ).fit()
+    assert not results.errors
+    assert results.get_best_result().metrics["ok"] == 1
+
+    # without retries the error surfaces
+    d2 = tempfile.mkdtemp()
+
+    def always_fails(config):
+        raise ValueError("nope")
+
+    results2 = tune.Tuner(
+        always_fails,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+        run_config=tune.TuneRunConfig(storage_path=tempfile.mkdtemp()),
+    ).fit()
+    assert len(results2.errors) == 1
+    assert "nope" in results2.errors[0]
+
+
+def test_checkpoint_report_and_restore(ray_start):
+    from ray_tpu import tune
+    from ray_tpu.train import Checkpoint
+
+    def trainable(config):
+        import json
+
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["step"]
+        for step in range(start, 3):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"step": step + 1}, f)
+            tune.report({"step": step + 1},
+                        checkpoint=Checkpoint.from_directory(d))
+
+    storage = tempfile.mkdtemp()
+    results = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="step", mode="max"),
+        run_config=tune.TuneRunConfig(storage_path=storage, name="ckpt_exp"),
+    ).fit()
+    assert not results.errors
+    r = results.get_best_result()
+    assert r.checkpoint is not None
+    assert os.path.exists(os.path.join(r.checkpoint.path, "state.json"))
+
+
+def test_experiment_resume(ray_start):
+    """Tuner.restore picks up unfinished trials from persisted state."""
+    import json
+
+    from ray_tpu import tune
+    from ray_tpu.tune.trial import Trial
+
+    storage = tempfile.mkdtemp()
+    exp_dir = os.path.join(storage, "resume_exp")
+    os.makedirs(exp_dir)
+    # craft a state file with one finished + one pending trial
+    done = Trial(config={"x": 1}, experiment_dir=exp_dir)
+    done.status = "TERMINATED"
+    done.last_result = {"score": 10, "training_iteration": 1}
+    pend = Trial(config={"x": 5}, experiment_dir=exp_dir)
+    with open(os.path.join(exp_dir, "experiment_state.json"), "w") as f:
+        json.dump({"trials": [done.to_json(), pend.to_json()]}, f)
+
+    def trainable(config):
+        tune.report({"score": config["x"] * 10})
+
+    results = tune.Tuner.restore(
+        exp_dir, trainable,
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(results) == 2
+    assert results.get_best_result().metrics["score"] == 50
+
+
+def test_experiment_resume_continues_search(ray_start):
+    """With param_space, restore keeps generating not-yet-created samples."""
+    import json
+
+    from ray_tpu import tune
+    from ray_tpu.tune.trial import Trial
+
+    storage = tempfile.mkdtemp()
+    exp_dir = os.path.join(storage, "cont_exp")
+    os.makedirs(exp_dir)
+    space = {"x": tune.grid_search([1, 2, 3, 4])}
+    done = Trial(config={"x": 1}, experiment_dir=exp_dir)
+    done.status = "TERMINATED"
+    done.last_result = {"score": 10, "training_iteration": 1}
+    with open(os.path.join(exp_dir, "experiment_state.json"), "w") as f:
+        json.dump({"trials": [done.to_json()]}, f)
+
+    def trainable(config):
+        tune.report({"score": config["x"] * 10})
+
+    results = tune.Tuner.restore(
+        exp_dir, trainable,
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        param_space=space,
+    ).fit()
+    # 1 restored + 3 newly generated grid points
+    assert len(results) == 4
+    assert sorted(r.config["x"] for r in results) == [1, 2, 3, 4]
+
+
+def test_pbt_exploits_good_trials(ray_start):
+    """Weak PBT trials clone the strong trial's checkpoint + perturbed config."""
+    import json
+
+    from ray_tpu import tune
+    from ray_tpu.train import Checkpoint
+
+    def trainable(config):
+        # score accumulates by `rate` each step; checkpoint carries the total
+        total = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt:
+            with open(os.path.join(ckpt.path, "s.json")) as f:
+                total = json.load(f)["total"]
+        for _ in range(30):
+            total += config["rate"]
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "s.json"), "w") as f:
+                json.dump({"total": total}, f)
+            tune.report({"total": total}, checkpoint=Checkpoint.from_directory(d))
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"rate": tune.grid_search([0.01, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="total", mode="max",
+            scheduler=tune.PopulationBasedTraining(
+                perturbation_interval=5,
+                hyperparam_mutations={"rate": {"lower": 0.5, "upper": 2.0}},
+                quantile_fraction=0.5, seed=0),
+            max_concurrent_trials=2,
+        ),
+        run_config=tune.TuneRunConfig(storage_path=tempfile.mkdtemp()),
+    ).fit()
+    assert not results.errors
+    # the weak trial must have exploited: its final total is far above what
+    # rate=0.01 alone could reach (30 * 0.01 = 0.3)
+    finals = sorted(r.metrics["total"] for r in results)
+    assert finals[0] > 1.0
