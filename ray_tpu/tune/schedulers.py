@@ -54,17 +54,21 @@ class _Bracket:
             if t < milestone:
                 continue
             recorded = self.rungs[milestone]
-            if trial_id in recorded:
-                break  # already judged at this rung
-            action = CONTINUE
-            if recorded:
-                # cutoff = top 1/rf quantile of per-trial crossing scores
+            # record the score seen when this trial first crosses the rung;
+            # all judging uses these crossing scores so every comparison is
+            # at the same t (current-report scores are at incomparable t)
+            recorded.setdefault(trial_id, score)
+            # re-judged on EVERY report while this is the trial's highest
+            # rung: a trial that crossed an empty rung gets re-checked once
+            # peers arrive, so rung order doesn't decide survival
+            if len(recorded) >= 2:
+                # cutoff = top 1/rf quantile of per-trial crossing scores;
+                # own score is included, so the rung's best can never stop
                 vals = sorted(recorded.values(), reverse=True)
                 cutoff = vals[max(0, int(len(vals) / rf) - 1)]
-                if score < cutoff:
-                    action = STOP
-            recorded[trial_id] = score
-            return action
+                if recorded[trial_id] < cutoff:
+                    return STOP
+            return CONTINUE
         return CONTINUE
 
 
