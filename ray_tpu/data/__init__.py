@@ -1,0 +1,45 @@
+"""ray_tpu.data — distributed Arrow-blocked data pipelines on the task core.
+
+Equivalent of the reference data library (reference: python/ray/data/ —
+Dataset dataset.py:178, streaming executor _internal/execution/
+streaming_executor.py:49). All block transforms run as ray_tpu tasks over
+object-store blocks; ingestion ends in `iter_jax_batches` device feeding.
+"""
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.datasource import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_csv,
+    read_images,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "BlockAccessor",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "GroupedData",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_csv",
+    "read_images",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

@@ -1,0 +1,170 @@
+"""Blocks: the unit of data the executor moves through the object store.
+
+A Block is a pyarrow.Table (reference: python/ray/data/block.py:216 —
+Block = Arrow/pandas table; ours is Arrow-only internally, with pandas /
+numpy views materialized at the API boundary). BlockAccessor gives the
+format-agnostic operations the planner and operators need.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+# Column name used when the user data is a bare sequence of scalars/arrays
+# (reference uses the same convention, data/_internal/util.py "item").
+ITEM_COL = "item"
+
+
+def _to_table(data: Any) -> pa.Table:
+    """Normalize user data (table / pandas / dict of columns / list of rows /
+    list of scalars) into an Arrow table."""
+    if isinstance(data, pa.Table):
+        return data
+    if hasattr(data, "to_arrow"):  # e.g. polars-like
+        return data.to_arrow()
+    try:
+        import pandas as pd
+
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(data, dict):
+        arrays, fields = [], []
+        for k, v in data.items():
+            v = np.asarray(v)
+            if v.ndim > 1:
+                # tensor column: fixed-size-list array, element shape kept in
+                # field metadata so to_numpy() restores (N, *shape)
+                arr = _tensor_to_arrow(v)
+                meta = {b"tensor_shape": ",".join(map(str, v.shape[1:])).encode()}
+                fields.append(pa.field(k, arr.type, metadata=meta))
+                arrays.append(arr)
+            else:
+                arr = pa.array(v)
+                fields.append(pa.field(k, arr.type))
+                arrays.append(arr)
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+    if isinstance(data, list):
+        if data and isinstance(data[0], dict):
+            return pa.Table.from_pylist(data)
+        return pa.table({ITEM_COL: pa.array(data)})
+    raise TypeError(f"cannot convert {type(data)} to a Block")
+
+
+def _tensor_to_arrow(arr: np.ndarray) -> pa.Array:
+    """Store an (N, ...) ndarray as an Arrow FixedSizeListArray (flattened),
+    shape carried in the field metadata by the accessor on read-back via
+    reshape. For ragged/complex cases fall back to object pickling per row."""
+    n = arr.shape[0]
+    flat = np.ascontiguousarray(arr).reshape(n, -1)
+    inner = pa.array(flat.reshape(-1))
+    fsl = pa.FixedSizeListArray.from_arrays(inner, flat.shape[1])
+    return fsl
+
+
+class BlockAccessor:
+    """Format-agnostic view over one Arrow table block (reference:
+    data/block.py BlockAccessor / _internal/arrow_block.py)."""
+
+    def __init__(self, table: pa.Table):
+        self._t = table
+
+    @staticmethod
+    def for_block(block: Any) -> "BlockAccessor":
+        return BlockAccessor(_to_table(block))
+
+    @property
+    def table(self) -> pa.Table:
+        return self._t
+
+    def num_rows(self) -> int:
+        return self._t.num_rows
+
+    def size_bytes(self) -> int:
+        return self._t.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._t.schema
+
+    def slice(self, start: int, end: int) -> pa.Table:
+        return self._t.slice(start, end - start)
+
+    def to_pandas(self):
+        return self._t.to_pandas()
+
+    def to_numpy(self, columns: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        cols = columns or self._t.column_names
+        out = {}
+        for name in cols:
+            col = self._t.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                arrs = col.combine_chunks()
+                if isinstance(arrs, pa.ChunkedArray):
+                    arrs = arrs.chunk(0)
+                width = col.type.list_size
+                flat = arrs.flatten().to_numpy(zero_copy_only=False)
+                field = self._t.schema.field(name)
+                meta = field.metadata or {}
+                if b"tensor_shape" in meta:
+                    shape = tuple(
+                        int(d) for d in meta[b"tensor_shape"].decode().split(",")
+                        if d)
+                    out[name] = flat.reshape((len(col),) + shape)
+                else:
+                    out[name] = flat.reshape(len(col), width)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pylist(self) -> List[dict]:
+        return self._t.to_pylist()
+
+    def iter_rows(self) -> Iterable[dict]:
+        for batch in self._t.to_batches():
+            yield from batch.to_pylist()
+
+    def take_rows(self, indices: np.ndarray) -> pa.Table:
+        return self._t.take(pa.array(indices))
+
+    def sample(self, n: int, seed: Optional[int] = None) -> pa.Table:
+        rng = np.random.default_rng(seed)
+        n = min(n, self._t.num_rows)
+        idx = rng.choice(self._t.num_rows, size=n, replace=False)
+        return self.take_rows(idx)
+
+    def sort(self, key: str, descending: bool = False) -> pa.Table:
+        order = "descending" if descending else "ascending"
+        idx = pc.sort_indices(self._t, sort_keys=[(key, order)])
+        return self._t.take(idx)
+
+    @staticmethod
+    def concat(tables: List[pa.Table]) -> pa.Table:
+        nonempty = [t for t in tables if t.num_rows > 0]
+        if not nonempty:
+            # preserve schema of all-empty inputs (repartition edge blocks)
+            for t in tables:
+                if t.schema.names:
+                    return t.slice(0, 0)
+            return pa.table({})
+        return pa.concat_tables(nonempty, promote_options="permissive")
+
+
+def format_batch(table: pa.Table, batch_format: str):
+    """Materialize a block slice in the format map_batches/iter_batches asked
+    for (reference: data/_internal/batcher + block accessor to_batch_format)."""
+    acc = BlockAccessor(table)
+    if batch_format in ("pyarrow", "arrow"):
+        return table
+    if batch_format == "pandas":
+        return acc.to_pandas()
+    if batch_format in ("numpy", "default", None):
+        return acc.to_numpy()
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def batch_to_table(batch: Any) -> pa.Table:
+    return _to_table(batch)

@@ -1,0 +1,765 @@
+"""Dataset: lazy, distributed, Arrow-blocked data pipelines.
+
+Equivalent of the reference Dataset (reference: python/ray/data/dataset.py:178
+— map_batches :397, iter_batches :3499, streaming_split :1149) built on the
+ray_tpu task core. The plan is a list of logical ops; consecutive one-to-one
+ops fuse into single tasks per block; all-to-all ops (repartition /
+random_shuffle / sort / groupby) run as two-stage num_returns=N exchanges
+(reference: _internal/push_based_shuffle.py).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu._private import task_spec as ts
+from ray_tpu.data import executor as ex
+from ray_tpu.data.block import (
+    ITEM_COL,
+    BlockAccessor,
+    batch_to_table,
+    format_batch,
+)
+from ray_tpu.data.context import DataContext
+
+# ---------------------------------------------------------------------------
+# logical ops
+# ---------------------------------------------------------------------------
+
+
+class _Op:
+    pass
+
+
+class _Read(_Op):
+    def __init__(self, sources: List[Any], read_fn: Callable[[Any], pa.Table]):
+        self.sources = sources
+        self.read_fn = read_fn
+
+
+class _FromBundles(_Op):
+    def __init__(self, bundles: List[ex.RefBundle]):
+        self.bundles = bundles
+
+
+class _MapBlock(_Op):
+    """Any one-to-one block transform (map/filter/flat_map/map_batches/
+    project); fusable."""
+
+    def __init__(self, fn: Callable[[pa.Table], pa.Table], name: str):
+        self.fn = fn
+        self.name = name
+
+
+class _Limit(_Op):
+    def __init__(self, n: int):
+        self.n = n
+
+
+class _AllToAll(_Op):
+    """Two-stage exchange. map_fn(table, n, idx) -> n tables;
+    reduce_fn(list) -> table. n_out resolved at execution (callable takes
+    current bundle list)."""
+
+    def __init__(self, map_fn, reduce_fn, n_out, name: str,
+                 needs_bundles: bool = False, prepare=None,
+                 keep_empty: bool = False):
+        self.map_fn = map_fn
+        self.reduce_fn = reduce_fn
+        self.n_out = n_out
+        self.name = name
+        # prepare(bundles) -> (map_fn, reduce_fn, n_out): built once metas
+        # of the input bundles are known (sort boundaries, repartition ranges)
+        self.prepare = prepare
+        self.keep_empty = keep_empty  # exact-n ops keep empty output blocks
+
+
+class _Union(_Op):
+    def __init__(self, others: List["Dataset"]):
+        self.others = others
+
+
+class _Zip(_Op):
+    def __init__(self, other: "Dataset"):
+        self.other = other
+
+
+# ---------------------------------------------------------------------------
+
+
+def _chain(fns: List[Callable]) -> Callable:
+    if len(fns) == 1:
+        return fns[0]
+
+    def chained(x):
+        for f in fns:
+            x = f(x)
+        return x
+
+    return chained
+
+
+class Dataset:
+    """Lazy dataset. All transforms return a new Dataset sharing upstream
+    plan; execution happens on consumption (iter/take/count/write/...)."""
+
+    def __init__(self, plan: List[_Op], ctx: Optional[DataContext] = None):
+        self._plan = plan
+        self._ctx = ctx or DataContext.get_current()
+        self._cached: Optional[List[ex.RefBundle]] = None
+        self._schema: Optional[pa.Schema] = None
+
+    # -- plan building ------------------------------------------------------
+
+    def _with(self, op: _Op) -> "Dataset":
+        return Dataset(self._plan + [op], self._ctx)
+
+    def _map_op(self, fn, name) -> "Dataset":
+        return self._with(_MapBlock(fn, name))
+
+    # -- transforms (one-to-one, fused) ------------------------------------
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        def do(table: pa.Table) -> pa.Table:
+            rows = [fn(r) for r in BlockAccessor(table).iter_rows()]
+            return pa.Table.from_pylist(rows) if rows else table.slice(0, 0)
+
+        return self._map_op(do, "map")
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        def do(table: pa.Table) -> pa.Table:
+            mask = [bool(fn(r)) for r in BlockAccessor(table).iter_rows()]
+            return table.filter(pa.array(mask, type=pa.bool_()))
+
+        return self._map_op(do, "filter")
+
+    def flat_map(self, fn: Callable[[dict], List[dict]]) -> "Dataset":
+        def do(table: pa.Table) -> pa.Table:
+            rows: List[dict] = []
+            for r in BlockAccessor(table).iter_rows():
+                rows.extend(fn(r))
+            return pa.Table.from_pylist(rows) if rows else table.slice(0, 0)
+
+        return self._map_op(do, "flat_map")
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: Optional[str] = None,
+        fn_kwargs: Optional[dict] = None,
+        **_ignored,
+    ) -> "Dataset":
+        """Apply fn to batches (reference: dataset.py:397). fn receives the
+        batch in `batch_format` (numpy dict default / pandas / pyarrow) and
+        returns same-ish; batch_size splits within a block."""
+        fmt = batch_format or self._ctx.default_batch_format
+        kwargs = fn_kwargs or {}
+
+        def do(table: pa.Table) -> pa.Table:
+            n = table.num_rows
+            if n == 0:
+                return table
+            size = batch_size or n
+            outs = []
+            for start in range(0, n, size):
+                piece = table.slice(start, min(size, n - start))
+                out = fn(format_batch(piece, fmt), **kwargs)
+                outs.append(batch_to_table(out))
+            return BlockAccessor.concat(outs)
+
+        return self._map_op(do, "map_batches")
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def do(table: pa.Table) -> pa.Table:
+            batch = BlockAccessor(table).to_numpy()
+            col = np.asarray(fn(batch))
+            return table.append_column(name, pa.array(col))
+
+        return self._map_op(do, "add_column")
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._map_op(lambda t: t.drop_columns(cols), "drop_columns")
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._map_op(lambda t: t.select(cols), "select_columns")
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def do(table: pa.Table) -> pa.Table:
+            return table.rename_columns(
+                [mapping.get(c, c) for c in table.column_names]
+            )
+
+        return self._map_op(do, "rename_columns")
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(_Limit(n))
+
+    # -- transforms (all-to-all) -------------------------------------------
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Exact re-split into num_blocks, preserving global row order
+        (reference: dataset.py repartition shuffle=False path)."""
+
+        def prepare(bundles):
+            rows = [m.num_rows for _, m in bundles]
+            offsets = np.concatenate([[0], np.cumsum(rows)])
+            total = int(offsets[-1])
+            # target global row ranges per output block
+            bounds = [round(total * j / num_blocks) for j in range(num_blocks + 1)]
+
+            def map_fn(table, n, idx):
+                lo = int(offsets[idx])
+                out = []
+                for j in range(n):
+                    s = max(bounds[j] - lo, 0)
+                    e = min(bounds[j + 1] - lo, table.num_rows)
+                    out.append(table.slice(s, max(e - s, 0)))
+                return out
+
+            def reduce_fn(parts):
+                return BlockAccessor.concat(parts)
+
+            return map_fn, reduce_fn, num_blocks
+
+        return self._with(_AllToAll(None, None, None, "repartition",
+                                    prepare=prepare, keep_empty=True))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global row shuffle as a 2-stage exchange (reference:
+        dataset.py random_shuffle → push_based_shuffle)."""
+
+        def prepare(bundles):
+            n_out = max(1, len(bundles))
+            base = seed if seed is not None else np.random.randint(0, 2**31)
+
+            def map_fn(table, n, idx):
+                rng = np.random.default_rng(base * 100003 + idx)
+                assign = rng.integers(0, n, table.num_rows)
+                return [table.filter(pa.array(assign == j)) for j in range(n)]
+
+            def reduce_fn(parts):
+                t = BlockAccessor.concat(parts)
+                if t.num_rows == 0:
+                    return t
+                rng = np.random.default_rng(base + 17)
+                return t.take(pa.array(rng.permutation(t.num_rows)))
+
+            return map_fn, reduce_fn, n_out
+
+        return self._with(_AllToAll(None, None, None, "random_shuffle",
+                                    prepare=prepare))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Sample-partitioned distributed sort (reference: dataset.py sort →
+        _internal/planner/exchange/sort_task_spec.py boundary sampling)."""
+
+        def prepare(bundles):
+            n_out = max(1, len(bundles))
+            # boundary sampling: fetch a small sample of the key column from
+            # each block, pick n_out-1 quantile boundaries
+            samples = []
+            sample_refs = [
+                ex._exec_block.options(num_returns=2).remote(
+                    ts.dumps_function(
+                        lambda t, k=key: BlockAccessor(t).sample(20, seed=0)
+                        .select([k])
+                    ),
+                    ref,
+                )
+                for ref, _ in bundles
+            ]
+            for block_ref, _meta in sample_refs:
+                t = ray_tpu.get(block_ref, timeout=600)
+                samples.append(t.column(key).to_numpy(zero_copy_only=False))
+            allv = np.sort(np.concatenate(samples))
+            qs = [allv[int(len(allv) * j / n_out)] for j in range(1, n_out)]
+
+            def map_fn(table, n, idx):
+                col = table.column(key).to_numpy(zero_copy_only=False)
+                part = np.searchsorted(np.asarray(qs), col, side="right")
+                if descending:
+                    part = (n - 1) - part
+                return [table.filter(pa.array(part == j)) for j in range(n)]
+
+            def reduce_fn(parts):
+                t = BlockAccessor.concat(parts)
+                if t.num_rows == 0:
+                    return t
+                return BlockAccessor(t).sort(key, descending)
+
+            return map_fn, reduce_fn, n_out
+
+        return self._with(_AllToAll(None, None, None, "sort", prepare=prepare))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(_Union(list(others)))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with(_Zip(other))
+
+    def random_sample(self, fraction: float, *, seed=None) -> "Dataset":
+        def do(table: pa.Table) -> pa.Table:
+            rng = np.random.default_rng(seed)
+            mask = rng.random(table.num_rows) < fraction
+            return table.filter(pa.array(mask))
+
+        return self._map_op(do, "random_sample")
+
+    # -- execution ----------------------------------------------------------
+
+    def _stream(self) -> Iterator[ex.RefBundle]:
+        """Execute the plan, yielding output bundles as they materialize."""
+        if self._cached is not None:
+            yield from self._cached
+            return
+
+        ctx = self._ctx
+        stream: Optional[Iterator[ex.RefBundle]] = None
+        sources: Optional[List[Any]] = None
+        read_fn: Optional[Callable] = None
+        fns: List[Callable] = []
+        limit: Optional[int] = None
+
+        def flush() -> Iterator[ex.RefBundle]:
+            nonlocal stream, sources, read_fn, fns, limit
+            if sources is not None:
+                chain = _chain([read_fn] + fns) if fns else read_fn
+                out = ex.run_oneone_stage(iter(sources), ts.dumps_function(chain),
+                                          ctx, limit_rows=limit)
+            elif fns:
+                chain = _chain(fns)
+                upstream = stream
+
+                def srcs():
+                    for ref, _m in upstream:
+                        yield ref
+
+                out = ex.run_oneone_stage(srcs(), ts.dumps_function(chain),
+                                          ctx, limit_rows=limit)
+            else:
+                out = stream if stream is not None else iter(())
+            if limit is not None:
+                out = _truncate(out, limit)
+            sources, read_fn, fns, limit = None, None, [], None
+            return out
+
+        def barrier() -> List[ex.RefBundle]:
+            return list(flush())
+
+        for op in self._plan:
+            if isinstance(op, _Read):
+                sources, read_fn = list(op.sources), op.read_fn
+            elif isinstance(op, _FromBundles):
+                stream = iter(op.bundles)
+            elif isinstance(op, _MapBlock):
+                if limit is not None:
+                    # a map after a limit must see only the limited rows —
+                    # flush so the truncation happens before this fn
+                    stream = flush()
+                fns.append(op.fn)
+            elif isinstance(op, _Limit):
+                limit = op.n if limit is None else min(limit, op.n)
+            elif isinstance(op, _AllToAll):
+                bundles = barrier()
+                map_fn, reduce_fn, n_out = op.prepare(bundles)
+                stream = iter(ex.run_all_to_all(
+                    bundles, ts.dumps_function(map_fn),
+                    ts.dumps_function(reduce_fn), n_out, ctx,
+                    keep_empty=op.keep_empty))
+            elif isinstance(op, _Union):
+                bundles = barrier()
+                tail = [iter(o._stream()) for o in op.others]
+
+                def chained(b=bundles, t=tail):
+                    yield from b
+                    for it in t:
+                        yield from it
+
+                stream = chained()
+            elif isinstance(op, _Zip):
+                left = barrier()
+                right = list(op.other._stream())
+                stream = iter(_zip_bundles(left, right, ctx))
+            else:
+                raise AssertionError(op)
+
+        yield from flush()
+
+    def materialize(self) -> "Dataset":
+        """Execute fully and pin blocks (reference: dataset.py materialize)."""
+        if self._cached is None:
+            self._cached = list(self._stream())
+        return self
+
+    # -- consumption --------------------------------------------------------
+
+    def count(self) -> int:
+        self.materialize()
+        return sum(m.num_rows for _, m in self._cached)
+
+    def num_blocks(self) -> int:
+        self.materialize()
+        return len(self._cached)
+
+    def size_bytes(self) -> int:
+        self.materialize()
+        return sum(m.size_bytes for _, m in self._cached)
+
+    def schema(self) -> Optional[pa.Schema]:
+        if self._schema is None:
+            for ref, _m in self._stream():
+                t = ray_tpu.get(ref, timeout=600)
+                self._schema = t.schema
+                break
+        return self._schema
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def take(self, n: int = 20) -> List[dict]:
+        out: List[dict] = []
+        for ref, _m in self._stream():
+            t = ray_tpu.get(ref, timeout=600)
+            for row in BlockAccessor(t).iter_rows():
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[dict]:
+        out: List[dict] = []
+        for ref, _m in self._stream():
+            out.extend(BlockAccessor(ray_tpu.get(ref, timeout=600)).to_pylist())
+        return out
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for ref, _m in self._stream():
+            yield from BlockAccessor(ray_tpu.get(ref, timeout=600)).iter_rows()
+
+    def to_pandas(self):
+        tables = [ray_tpu.get(r, timeout=600) for r, _ in self._stream()]
+        return BlockAccessor.concat(tables).to_pandas() if tables else None
+
+    def to_arrow(self) -> pa.Table:
+        tables = [ray_tpu.get(r, timeout=600) for r, _ in self._stream()]
+        return BlockAccessor.concat(tables)
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        return BlockAccessor(self.to_arrow()).to_numpy()
+
+    def to_arrow_refs(self) -> List["ray_tpu.ObjectRef"]:
+        self.materialize()
+        return [r for r, _ in self._cached]
+
+    # -- iteration (the Train ingestion path) -------------------------------
+
+    def iterator(self) -> "DataIterator":
+        from ray_tpu.data.iterator import DataIterator
+
+        return DataIterator(self)
+
+    def iter_batches(self, **kw) -> Iterator:
+        return self.iterator().iter_batches(**kw)
+
+    def iter_torch_batches(self, **kw) -> Iterator:
+        return self.iterator().iter_torch_batches(**kw)
+
+    def iter_jax_batches(self, **kw) -> Iterator:
+        return self.iterator().iter_jax_batches(**kw)
+
+    # -- splits -------------------------------------------------------------
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Split into n datasets at block granularity; equal=True rebalances
+        to exactly-equal row counts via the repartition exchange (reference:
+        dataset.py split/split_proportionately)."""
+        src = self
+        if equal:
+            total = self.count()
+            per = total // n
+            src = self.limit(per * n).repartition(n)
+        src.materialize()
+        bundles = src._cached
+        if equal:
+            parts = [[b] for b in bundles]
+        else:
+            parts = [bundles[i::n] for i in range(n)]
+        return [Dataset([_FromBundles(p)], self._ctx) for p in parts]
+
+    def streaming_split(self, n: int, *, equal: bool = True,
+                        locality_hints=None) -> List["DataIterator"]:
+        """Per-consumer iterators for train workers (reference:
+        dataset.py:1149). Shards are fixed up front; each DataIterator is
+        picklable (holds block refs) so it ships to worker actors."""
+        return [d.iterator() for d in self.split(n, equal=equal)]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed=None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        # materialize ONCE and split the pinned blocks — limit/_drop_first on
+        # the raw plan would each re-execute it (a fresh unseeded shuffle per
+        # branch would leak rows between the splits)
+        ds.materialize()
+        base = Dataset([_FromBundles(list(ds._cached))], self._ctx)
+        total = ds.count()
+        n_test = int(total * test_size) if test_size < 1 else int(test_size)
+        return base._drop_first(n_test), base.limit(n_test)
+
+    def _drop_first(self, n: int) -> "Dataset":
+        # keep per-input-block outputs: n_out = len(bundles), identity routing
+        def prepare2(bundles):
+            rows = [m.num_rows for _, m in bundles]
+            offsets = np.concatenate([[0], np.cumsum(rows)])
+            n_out = max(1, len(bundles))
+
+            def map_fn(table, nn, idx):
+                lo = int(offsets[idx])
+                s = min(max(n - lo, 0), table.num_rows)
+                out = [table.slice(0, 0)] * nn
+                out[idx % nn] = table.slice(s)
+                return out
+
+            return map_fn, BlockAccessor.concat, n_out
+
+        return self._with(_AllToAll(None, None, None, "drop_first",
+                                    prepare=prepare2))
+
+    # -- writes -------------------------------------------------------------
+
+    def _write(self, path: str, writer: Callable[[pa.Table, str], None],
+               ext: str) -> List[str]:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        self.materialize()
+        paths = []
+        for i, (ref, _m) in enumerate(self._cached):
+            t = ray_tpu.get(ref, timeout=600)
+            p = os.path.join(path, f"part-{i:05d}.{ext}")
+            writer(t, p)
+            paths.append(p)
+        return paths
+
+    def write_parquet(self, path: str) -> List[str]:
+        import pyarrow.parquet as pq
+
+        return self._write(path, lambda t, p: pq.write_table(t, p), "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        import pyarrow.csv as pcsv
+
+        return self._write(path, lambda t, p: pcsv.write_csv(t, p), "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        def w(t, p):
+            import json
+
+            with open(p, "w") as f:
+                for row in t.to_pylist():
+                    f.write(json.dumps(row) + "\n")
+
+        return self._write(path, w, "json")
+
+    # -- misc ---------------------------------------------------------------
+
+    def stats(self) -> str:
+        self.materialize()
+        return (f"Dataset(blocks={len(self._cached)}, "
+                f"rows={sum(m.num_rows for _, m in self._cached)}, "
+                f"bytes={sum(m.size_bytes for _, m in self._cached)})")
+
+    def __repr__(self) -> str:
+        names = [getattr(op, "name", type(op).__name__.strip("_")) for op in self._plan]
+        return f"Dataset({' -> '.join(names)})"
+
+
+def _truncate(stream: Iterator[ex.RefBundle], n: int) -> Iterator[ex.RefBundle]:
+    """Cap a bundle stream at n rows, slicing the boundary block."""
+    seen = 0
+    for ref, meta in stream:
+        if seen + meta.num_rows <= n:
+            seen += meta.num_rows
+            yield ref, meta
+        else:
+            keep = n - seen
+            if keep > 0:
+                t = ray_tpu.get(ref, timeout=600).slice(0, keep)
+                yield ex.put_block(t)
+            seen = n
+        if seen >= n:
+            return
+
+
+def _zip_bundles(left: List[ex.RefBundle], right: List[ex.RefBundle],
+                 ctx) -> List[ex.RefBundle]:
+    """Row-align right blocks to left block boundaries, then column-concat
+    blockwise (reference: dataset.py zip)."""
+    lrows = [m.num_rows for _, m in left]
+    # realign right side to left's row ranges
+    rtabs = [ray_tpu.get(r, timeout=600) for r, _ in right]
+    rall = BlockAccessor.concat(rtabs) if rtabs else pa.table({})
+    total_l = sum(lrows)
+    if rall.num_rows != total_l:
+        raise ValueError(
+            f"zip requires equal row counts: {total_l} vs {rall.num_rows}")
+    out: List[ex.RefBundle] = []
+    off = 0
+    for (lref, lmeta) in left:
+        lt = ray_tpu.get(lref, timeout=600)
+        rt = rall.slice(off, lmeta.num_rows)
+        off += lmeta.num_rows
+        merged = lt
+        for name in rt.column_names:
+            col = rt.column(name)
+            if name in merged.column_names:
+                name = name + "_1"
+            merged = merged.append_column(name, col)
+        out.append(ex.put_block(merged))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# groupby
+# ---------------------------------------------------------------------------
+
+
+class GroupedData:
+    """Hash-partitioned groupby (reference: python/ray/data/grouped_data.py):
+    aggregations run as a two-stage exchange — per-block partial aggregate,
+    hash-route by key, combine."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, col_fns: Dict[str, tuple]) -> Dataset:
+        """col_fns: out_col -> (in_col, partial, combine) where partial
+        aggregates within a block and combine merges partials."""
+        key = self._key
+
+        def prepare(bundles):
+            n_out = max(1, min(len(bundles), 8))
+
+            def map_fn(table, n, idx):
+                # partial aggregate per key within this block, then route by
+                # hash(key) so each reducer owns disjoint keys
+                import pandas as pd
+
+                df = BlockAccessor(table).to_pandas()
+                if df.empty:
+                    empty = pa.table({})
+                    return [empty] * n
+                g = df.groupby(key, sort=False)
+                partial = {key: [k for k, _ in g]}
+                for out_col, (in_col, pfn, _cfn) in col_fns.items():
+                    partial[out_col] = [pfn(sub[in_col]) for _, sub in g]
+                pt = pa.table(partial)
+                keys = pt.column(key).to_pandas()
+                h = pd.util.hash_pandas_object(keys, index=False).to_numpy()
+                assign = (h % n).astype(np.int64)
+                return [pt.filter(pa.array(assign == j)) for j in range(n)]
+
+            def reduce_fn(parts):
+                import pandas as pd
+
+                parts = [p for p in parts if p.num_rows]
+                if not parts:
+                    return pa.table({})
+                df = BlockAccessor(BlockAccessor.concat(parts)).to_pandas()
+                g = df.groupby(key, sort=False)
+                out = {key: [k for k, _ in g]}
+                for out_col, (_in, _pfn, cfn) in col_fns.items():
+                    out[out_col] = [cfn(sub[out_col]) for _, sub in g]
+                t = pa.table(out)
+                return BlockAccessor(t).sort(key)
+
+            return map_fn, reduce_fn, n_out
+
+        return self._ds._with(_AllToAll(None, None, None, "groupby",
+                                        prepare=prepare))
+
+    def count(self) -> Dataset:
+        return self._agg({"count()": (self._key, lambda s: len(s),
+                                      lambda s: s.sum())})
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg({f"sum({col})": (col, lambda s: s.sum(),
+                                          lambda s: s.sum())})
+
+    def min(self, col: str) -> Dataset:
+        return self._agg({f"min({col})": (col, lambda s: s.min(),
+                                          lambda s: s.min())})
+
+    def max(self, col: str) -> Dataset:
+        return self._agg({f"max({col})": (col, lambda s: s.max(),
+                                          lambda s: s.max())})
+
+    def mean(self, col: str) -> Dataset:
+        """mean via sum+count partials combined at reduce."""
+        key = self._key
+
+        out = self._agg({
+            f"__sum({col})": (col, lambda s: s.sum(), lambda s: s.sum()),
+            f"__cnt({col})": (col, lambda s: len(s), lambda s: s.sum()),
+        })
+
+        def finish(batch: dict) -> dict:
+            return {
+                key: batch[key],
+                f"mean({col})": batch[f"__sum({col})"] / batch[f"__cnt({col})"],
+            }
+
+        return out.map_batches(finish, batch_format="numpy")
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply fn(pandas.DataFrame) -> DataFrame/dict per group."""
+        key = self._key
+
+        def prepare(bundles):
+            n_out = max(1, min(len(bundles), 8))
+
+            def map_fn(table, n, idx):
+                import pandas as pd
+
+                if table.num_rows == 0:
+                    return [table.slice(0, 0)] * n
+                keys = table.column(key).to_pandas()
+                h = pd.util.hash_pandas_object(keys, index=False).to_numpy()
+                assign = (h % n).astype(np.int64)
+                return [table.filter(pa.array(assign == j)) for j in range(n)]
+
+            def reduce_fn(parts):
+                import pandas as pd
+
+                parts = [p for p in parts if p.num_rows]
+                if not parts:
+                    return pa.table({})
+                df = BlockAccessor(BlockAccessor.concat(parts)).to_pandas()
+                outs = []
+                for _k, sub in df.groupby(key, sort=True):
+                    r = fn(sub)
+                    if isinstance(r, dict):
+                        r = pd.DataFrame(r)
+                    outs.append(r)
+                return pa.Table.from_pandas(pd.concat(outs),
+                                            preserve_index=False)
+
+            return map_fn, reduce_fn, n_out
+
+        return self._ds._with(_AllToAll(None, None, None, "map_groups",
+                                        prepare=prepare))

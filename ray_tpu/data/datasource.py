@@ -1,0 +1,192 @@
+"""Datasources: creation + file reads (reference: python/ray/data/
+read_api.py and datasource/ — parquet/csv/json/text/numpy/range/items).
+Each read op is (sources, read_fn): one fused task per source."""
+from __future__ import annotations
+
+import glob as globmod
+import math
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import ITEM_COL, BlockAccessor, batch_to_table
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, _FromBundles, _Read
+from ray_tpu.data import executor as ex
+
+
+def _resolve_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(globmod.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths}")
+    return out
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:
+    """Integers [0, n) in `parallelism` blocks (reference: read_api.py
+    range — column name 'id')."""
+    import builtins
+
+    ctx = DataContext.get_current()
+    p = parallelism if parallelism > 0 else min(ctx.read_parallelism, max(1, n))
+    bounds = [round(n * i / p) for i in builtins.range(p + 1)]
+    sources = [(bounds[i], bounds[i + 1]) for i in builtins.range(p)]
+
+    def read(span) -> pa.Table:
+        lo, hi = span
+        return pa.table({"id": np.arange(lo, hi, dtype=np.int64)})
+
+    return Dataset([_Read(sources, read)])
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    ctx = DataContext.get_current()
+    p = parallelism if parallelism > 0 else min(ctx.read_parallelism, max(1, n))
+    import builtins
+
+    bounds = [round(n * i / p) for i in builtins.range(p + 1)]
+    sources = [(bounds[i], bounds[i + 1]) for i in builtins.range(p)]
+
+    def read(span) -> pa.Table:
+        lo, hi = span
+        base = np.arange(lo, hi, dtype=np.int64).reshape((-1,) + (1,) * len(shape))
+        data = np.broadcast_to(base, (hi - lo,) + tuple(shape)).copy()
+        return batch_to_table({"data": data})
+
+    return Dataset([_Read(sources, read)])
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    ctx = DataContext.get_current()
+    import builtins
+
+    p = parallelism if parallelism > 0 else min(ctx.read_parallelism,
+                                                max(1, len(items)))
+    chunk = math.ceil(len(items) / p) if items else 1
+    sources = [items[i:i + chunk] for i in builtins.range(0, len(items), chunk)]
+
+    def read(chunk_items) -> pa.Table:
+        if chunk_items and isinstance(chunk_items[0], dict):
+            return pa.Table.from_pylist(chunk_items)
+        return pa.table({ITEM_COL: pa.array(chunk_items)})
+
+    return Dataset([_Read(sources or [[]], read)])
+
+
+def from_numpy(arrs, column: str = "data") -> Dataset:
+    if isinstance(arrs, np.ndarray):
+        arrs = [arrs]
+    sources = list(arrs)
+
+    def read(arr) -> pa.Table:
+        return batch_to_table({column: arr})
+
+    return Dataset([_Read(sources, read)])
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    bundles = [ex.put_block(pa.Table.from_pandas(df, preserve_index=False))
+               for df in dfs]
+    return Dataset([_FromBundles(bundles)])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return Dataset([_FromBundles([ex.put_block(t) for t in tables])])
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 parallelism: int = -1) -> Dataset:
+    files = _resolve_paths(paths)
+
+    def read(path) -> pa.Table:
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, columns=columns)
+
+    return Dataset([_Read(files, read)])
+
+
+def read_csv(paths, *, parallelism: int = -1, **csv_kwargs) -> Dataset:
+    files = _resolve_paths(paths)
+
+    def read(path) -> pa.Table:
+        import pyarrow.csv as pcsv
+
+        return pcsv.read_csv(path, **csv_kwargs)
+
+    return Dataset([_Read(files, read)])
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    """JSONL files (reference: read_api.py read_json)."""
+    files = _resolve_paths(paths)
+
+    def read(path) -> pa.Table:
+        import json
+
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        return pa.Table.from_pylist(rows) if rows else pa.table({})
+
+    return Dataset([_Read(files, read)])
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    files = _resolve_paths(paths)
+
+    def read(path) -> pa.Table:
+        with open(path) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return pa.table({"text": pa.array(lines)})
+
+    return Dataset([_Read(files, read)])
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    files = _resolve_paths(paths)
+
+    def read(path) -> pa.Table:
+        return batch_to_table({"data": np.load(path)})
+
+    return Dataset([_Read(files, read)])
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: str = "RGB", parallelism: int = -1) -> Dataset:
+    """Image directory → {'image': uint8 HWC tensor, 'path': str}
+    (reference: datasource/image_datasource.py)."""
+    files = [p for p in _resolve_paths(paths)
+             if p.lower().endswith((".png", ".jpg", ".jpeg", ".bmp", ".gif"))]
+
+    def read(path) -> pa.Table:
+        from PIL import Image
+
+        img = Image.open(path).convert(mode)
+        if size is not None:
+            img = img.resize(size)
+        arr = np.asarray(img)[None, ...]
+        t = batch_to_table({"image": arr})
+        return t.append_column("path", pa.array([path]))
+
+    return Dataset([_Read(files, read)])

@@ -24,6 +24,8 @@ class TrialScheduler:
 
     def _score(self, result: dict) -> float:
         v = float(result[self.metric])
+        if math.isnan(v):
+            return -math.inf  # diverged trials rank worst in either mode
         return v if self.mode == "max" else -v
 
     def on_trial_result(self, trial: Trial, result: dict) -> str:
@@ -74,10 +76,13 @@ class _Bracket:
 
 class AsyncHyperBandScheduler(TrialScheduler):
     """ASHA (reference: tune/schedulers/async_hyperband.py:19): rungs at
-    grace_period * reduction_factor**k; a trial crossing a rung stops unless
-    its crossing score is in the top 1/reduction_factor of the per-trial
-    scores recorded at that rung. Multiple brackets stagger grace periods
-    (bracket s starts at grace * rf**s); trials are assigned round-robin."""
+    grace_period * reduction_factor**k; each trial's score is frozen when it
+    first crosses a rung, and while that rung is the trial's highest it is
+    re-judged on every report: it stops as soon as its frozen crossing score
+    falls out of the top 1/reduction_factor of all scores recorded at the
+    rung (so a trial that crossed an empty rung can be stopped later, once
+    enough peers arrive). Multiple brackets stagger grace periods (bracket s
+    starts at grace * rf**s); trials are assigned round-robin."""
 
     def __init__(self, time_attr: str = "training_iteration",
                  grace_period: int = 1, reduction_factor: float = 3,

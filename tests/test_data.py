@@ -1,0 +1,329 @@
+"""Data layer: datasets, transforms, shuffles, groupby, iteration
+(model: reference python/ray/data/tests/ — test_map.py, test_sort.py,
+test_consumption.py, test_splitblocks.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+
+def test_range_count_take_schema(ray_start):
+    from ray_tpu import data
+
+    ds = data.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 4
+    assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
+    assert ds.columns() == ["id"]
+
+
+def test_map_filter_flatmap_fusion(ray_start):
+    from ray_tpu import data
+
+    ds = (data.range(20, parallelism=2)
+          .map(lambda r: {"id": r["id"], "sq": r["id"] ** 2})
+          .filter(lambda r: r["id"] % 2 == 0)
+          .flat_map(lambda r: [r, r]))
+    rows = ds.take_all()
+    assert len(rows) == 20  # 10 even ids, duplicated
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+
+
+def test_map_batches_formats(ray_start):
+    from ray_tpu import data
+
+    ds = data.range(32, parallelism=2)
+
+    def np_fn(batch):
+        assert isinstance(batch, dict)
+        return {"id": batch["id"], "x2": batch["id"] * 2}
+
+    assert data.range(8).map_batches(np_fn).take(3)[2]["x2"] == 4
+
+    def pd_fn(df):
+        df["neg"] = -df["id"]
+        return df
+
+    rows = ds.map_batches(pd_fn, batch_format="pandas", batch_size=10).take_all()
+    assert len(rows) == 32
+    assert rows[5]["neg"] == -5
+
+    def pa_fn(t):
+        import pyarrow as pa
+
+        return t.append_column("one", pa.array([1] * t.num_rows))
+
+    assert ds.map_batches(pa_fn, batch_format="pyarrow").take(1)[0]["one"] == 1
+
+
+def test_column_ops_and_limit(ray_start):
+    from ray_tpu import data
+
+    ds = (data.range(50, parallelism=4)
+          .add_column("y", lambda b: b["id"] + 1)
+          .rename_columns({"id": "x"}))
+    assert set(ds.columns()) == {"x", "y"}
+    rows = ds.limit(7).take_all()
+    assert len(rows) == 7
+    assert rows[6] == {"x": 6, "y": 7}
+    assert ds.select_columns(["y"]).columns() == ["y"]
+
+
+def test_repartition_preserves_order(ray_start):
+    from ray_tpu import data
+
+    ds = data.range(103, parallelism=5).repartition(3)
+    assert ds.num_blocks() == 3
+    assert [r["id"] for r in ds.take_all()] == list(range(103))
+
+
+def test_random_shuffle_permutes(ray_start):
+    from ray_tpu import data
+
+    ids = [r["id"] for r in
+           data.range(200, parallelism=4).random_shuffle(seed=7).take_all()]
+    assert sorted(ids) == list(range(200))
+    assert ids != list(range(200))
+    # deterministic given a seed
+    ids2 = [r["id"] for r in
+            data.range(200, parallelism=4).random_shuffle(seed=7).take_all()]
+    assert ids == ids2
+
+
+def test_sort_distributed(ray_start):
+    from ray_tpu import data
+
+    vals = [((i * 7919) % 501) for i in range(500)]
+    ds = data.from_items([{"v": v} for v in vals], parallelism=5).sort("v")
+    out = [r["v"] for r in ds.take_all()]
+    assert out == sorted(vals)
+    out_d = [r["v"] for r in
+             data.from_items([{"v": v} for v in vals], parallelism=5)
+             .sort("v", descending=True).take_all()]
+    assert out_d == sorted(vals, reverse=True)
+
+
+def test_groupby_aggregations(ray_start):
+    from ray_tpu import data
+
+    ds = data.from_items(
+        [{"k": i % 3, "v": float(i)} for i in range(30)], parallelism=4)
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums[0] == sum(float(i) for i in range(30) if i % 3 == 0)
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    assert means[1] == pytest.approx(sums_of(1) / 10)
+
+
+def sums_of(k):
+    return sum(float(i) for i in range(30) if i % 3 == k)
+
+
+def test_union_zip(ray_start):
+    from ray_tpu import data
+
+    a = data.range(10, parallelism=2)
+    b = data.range(10, parallelism=2).map(lambda r: {"id": r["id"] + 10})
+    assert a.union(b).count() == 20
+    z = data.range(6, parallelism=2).zip(
+        data.range(6, parallelism=3).map(lambda r: {"sq": r["id"] ** 2}))
+    rows = z.take_all()
+    assert rows[4] == {"id": 4, "sq": 16}
+
+
+def test_parquet_csv_json_roundtrip(ray_start):
+    from ray_tpu import data
+
+    d = tempfile.mkdtemp()
+    src = data.range(40, parallelism=3).add_column("v", lambda b: b["id"] * 0.5)
+    src.write_parquet(os.path.join(d, "pq"))
+    back = data.read_parquet(os.path.join(d, "pq"))
+    assert back.count() == 40
+    assert back.sort("id").take(2)[1]["v"] == 0.5
+
+    src.write_csv(os.path.join(d, "csv"))
+    assert data.read_csv(os.path.join(d, "csv")).count() == 40
+
+    src.write_json(os.path.join(d, "js"))
+    assert data.read_json(os.path.join(d, "js")).count() == 40
+
+
+def test_from_pandas_numpy_arrow(ray_start):
+    import pandas as pd
+    import pyarrow as pa
+
+    from ray_tpu import data
+
+    df = pd.DataFrame({"a": [1, 2, 3]})
+    assert data.from_pandas(df).count() == 3
+    nd = data.from_numpy(np.ones((4, 2, 2)))
+    batch = next(nd.iter_batches(batch_size=4))
+    assert batch["data"].shape == (4, 2, 2)
+    assert data.from_arrow(pa.table({"x": [1, 2]})).take_all() == [
+        {"x": 1}, {"x": 2}]
+
+
+def test_iter_batches_sizes_and_drop_last(ray_start):
+    from ray_tpu import data
+
+    ds = data.range(25, parallelism=4)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=10)]
+    assert sizes == [10, 10, 5]
+    sizes = [len(b["id"]) for b in
+             ds.iter_batches(batch_size=10, drop_last=True)]
+    assert sizes == [10, 10]
+    # coalesces across block boundaries: every batch full-size
+    all_ids = []
+    for b in ds.iter_batches(batch_size=7, drop_last=False):
+        all_ids.extend(b["id"].tolist())
+    assert all_ids == list(range(25))
+
+
+def test_local_shuffle_buffer(ray_start):
+    from ray_tpu import data
+
+    ds = data.range(100, parallelism=2)
+    ids = []
+    for b in ds.iter_batches(batch_size=20, local_shuffle_buffer_size=40,
+                             local_shuffle_seed=3):
+        ids.extend(b["id"].tolist())
+    assert sorted(ids) == list(range(100))
+    assert ids != list(range(100))
+
+
+def test_split_and_streaming_split(ray_start):
+    from ray_tpu import data
+
+    ds = data.range(30, parallelism=6)
+    shards = ds.split(3)
+    assert sum(s.count() for s in shards) == 30
+    its = ds.streaming_split(3, equal=True)
+    counts = [it.count() for it in its]
+    assert counts == [10, 10, 10]
+    seen = []
+    for it in its:
+        for b in it.iter_batches(batch_size=5):
+            seen.extend(b["id"].tolist())
+    assert sorted(seen) == list(range(30))
+
+
+def test_iterator_ships_to_workers(ray_start):
+    """DataIterator must be picklable and consumable inside a task —
+    the Train ingestion path."""
+    import ray_tpu
+    from ray_tpu import data
+
+    its = data.range(16, parallelism=4).streaming_split(2, equal=True)
+
+    @ray_tpu.remote
+    def consume(it):
+        return sum(int(b["id"].sum()) for b in it.iter_batches(batch_size=4))
+
+    totals = ray_tpu.get([consume.remote(it) for it in its], timeout=120)
+    assert sum(totals) == sum(range(16))
+
+
+def test_iter_jax_batches_device(ray_start):
+    import jax
+
+    from ray_tpu import data
+
+    ds = data.range(12, parallelism=2)
+    batches = list(ds.iter_jax_batches(batch_size=6, prefetch=1))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], jax.Array)
+    assert int(batches[0]["id"].sum()) == sum(range(6))
+
+
+def test_tensor_columns_roundtrip(ray_start):
+    from ray_tpu import data
+
+    arr = np.arange(24, dtype=np.float32).reshape(6, 2, 2)
+    ds = data.from_numpy(arr)
+    out = next(ds.iter_batches(batch_size=6))["data"]
+    np.testing.assert_array_equal(out.reshape(6, 2, 2), arr)
+
+
+def test_train_test_split(ray_start):
+    from ray_tpu import data
+
+    train, test = data.range(50, parallelism=5).train_test_split(0.2)
+    assert test.count() == 10
+    assert train.count() == 40
+    ids = sorted(r["id"] for r in train.take_all() + test.take_all())
+    assert ids == list(range(50))
+
+
+def test_train_test_split_shuffled_is_a_partition(ray_start):
+    """shuffle=True without a seed must still produce disjoint, exhaustive
+    splits (the shuffle must execute once, not once per branch)."""
+    from ray_tpu import data
+
+    train, test = data.range(50, parallelism=5).train_test_split(
+        0.2, shuffle=True)
+    tr = [r["id"] for r in train.take_all()]
+    te = [r["id"] for r in test.take_all()]
+    assert len(tr) == 40 and len(te) == 10
+    assert sorted(tr + te) == list(range(50))
+
+
+def test_limit_before_map_applies_first(ray_start):
+    """ops after a limit must see only the limited rows."""
+    from ray_tpu import data
+
+    n = (data.range(100, parallelism=4).limit(10)
+         .filter(lambda r: r["id"] % 2 == 0).count())
+    assert n == 5
+
+
+def test_repartition_exact_block_count_with_empties(ray_start):
+    from ray_tpu import data
+
+    ds = data.range(5, parallelism=2).repartition(8)
+    assert ds.num_blocks() == 8
+    assert ds.count() == 5
+
+
+def test_filter_empty_block_chain(ray_start):
+    from ray_tpu import data
+
+    out = (data.range(10, parallelism=2)
+           .filter(lambda r: r["id"] < 0)
+           .filter(lambda r: True).take_all())
+    assert out == []
+
+
+def test_local_shuffle_crosses_batch_boundaries(ray_start):
+    from ray_tpu import data
+
+    ds = data.range(100, parallelism=2)
+    batches = [set(b["id"].tolist()) for b in ds.iter_batches(
+        batch_size=20, local_shuffle_buffer_size=40, local_shuffle_seed=3)]
+    # with a 40-row sliding buffer, some batch must mix rows from
+    # non-adjacent 20-row spans
+    mixed = any(max(b) - min(b) > 20 for b in batches)
+    assert mixed
+    assert sorted(x for b in batches for x in b) == list(range(100))
+
+
+def test_early_break_does_not_leak_feeder(ray_start):
+    import threading
+
+    from ray_tpu import data
+
+    for _ in range(3):
+        it = data.range(100, parallelism=8).iter_batches(batch_size=5)
+        next(it)
+        it.close()
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        feeders = [t for t in threading.enumerate()
+                   if t.name == "ray_tpu-data-feeder" and t.is_alive()]
+        if not feeders:
+            break
+        time.sleep(0.2)
+    assert not feeders
