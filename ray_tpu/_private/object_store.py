@@ -141,7 +141,11 @@ class ObjectStoreClient:
         else:
             mm = self._map(shm_name, size, writable=True)
             m = _Mapping(memoryview(mm), mm)
-        self._cache_mapping(object_id.binary(), m)
+        # replace=True: after evict+reconstruct the server hands out a NEW
+        # shm segment; reusing a stale cached mapping would swallow the
+        # writes into unlinked pages, leaving the recreated object unsealed
+        # forever.
+        self._cache_mapping(object_id.binary(), m, replace=True)
         return m.buf
 
     def seal(self, object_id: ObjectID) -> None:
@@ -189,11 +193,19 @@ class ObjectStoreClient:
             # Pinned bytes on the server thus stay transient.
             self._request(OP_RELEASE, key)
 
-    def _cache_mapping(self, key: bytes, m: _Mapping) -> _Mapping:
+    def _cache_mapping(self, key: bytes, m: _Mapping, replace: bool = False) -> _Mapping:
         """Insert-or-get under the lock; loser of a concurrent double-fetch
-        is closed. Returns the canonical mapping for `key`."""
+        is closed. Returns the canonical mapping for `key`.
+
+        replace=True makes `m` the canonical mapping even if one is cached
+        (create() after evict+reconstruct). The displaced mapping is dropped
+        without close(): readers may still hold its exported view, and the
+        GC closes the mmap once the last view dies."""
         with self._map_lock:
             existing = self._mappings.get(key)
+            if existing is not None and replace:
+                del self._mappings[key]
+                existing = None
             if existing is not None:
                 self._mappings.move_to_end(key)
                 m.close()
