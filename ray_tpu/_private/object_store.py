@@ -103,6 +103,8 @@ class ObjectStoreClient:
         from collections import OrderedDict
 
         self._mappings: "OrderedDict[bytes, _Mapping]" = OrderedDict()
+        # created-but-not-sealed mappings, promoted to _mappings on seal()
+        self._pending_creates: dict[bytes, _Mapping] = {}
         self._map_lock = threading.Lock()
 
     def _request(self, op: int, object_id: bytes, payload: bytes = b"") -> tuple[int, bytes]:
@@ -141,17 +143,27 @@ class ObjectStoreClient:
         else:
             mm = self._map(shm_name, size, writable=True)
             m = _Mapping(memoryview(mm), mm)
-        # replace=True: after evict+reconstruct the server hands out a NEW
-        # shm segment; reusing a stale cached mapping would swallow the
-        # writes into unlinked pages, leaving the recreated object unsealed
-        # forever.
-        self._cache_mapping(object_id.binary(), m, replace=True)
+        # The writable mapping is NOT published to the get() cache yet —
+        # same-process readers must not see unsealed bytes; seal() promotes
+        # it. Any stale cached mapping (evict+reconstruct recreates the
+        # object under a NEW shm segment) is dropped now so no reader keeps
+        # hitting dead pages.
+        key = object_id.binary()
+        with self._map_lock:
+            self._mappings.pop(key, None)  # dropped, not closed: readers may
+            #                                still hold exported views
+            self._pending_creates[key] = m
         return m.buf
 
     def seal(self, object_id: ObjectID) -> None:
         st, _ = self._request(OP_SEAL, object_id.binary())
         if st != ST_OK:
             raise RuntimeError(f"seal failed: status {st}")
+        key = object_id.binary()
+        with self._map_lock:
+            m = self._pending_creates.pop(key, None)
+        if m is not None:
+            self._cache_mapping(key, m, replace=True)
 
     def get(self, object_id: ObjectID, timeout_ms: int = 0) -> memoryview | None:
         """Zero-copy read view, or None if absent (timeout_ms=0 → no wait)."""

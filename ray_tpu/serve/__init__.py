@@ -1,0 +1,47 @@
+"""ray_tpu.serve — model serving on the distributed core.
+
+Controller/reconciler + replica actors + client-side power-of-two routing +
+shape-aware dynamic batching + aiohttp ingress (reference: python/ray/serve —
+surveyed in SURVEY.md §2.3 A4). TPU-first: replicas hold chips via actor
+resources, and batching pads to fixed size buckets so jitted models never
+recompile (SURVEY.md §7 hard parts).
+"""
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch, pad_to_bucket
+from ray_tpu.serve.config import (
+    AutoscalingConfig,
+    BatchConfig,
+    DeploymentConfig,
+    HTTPOptions,
+)
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "BatchConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPOptions",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "pad_to_bucket",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

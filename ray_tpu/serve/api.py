@@ -1,0 +1,139 @@
+"""Serve public API: run / start / status / delete / shutdown / handles.
+
+Equivalent of the reference's serve api surface
+(reference: python/ray/serve/api.py — serve.run:479, serve.start,
+serve.status, serve.delete, serve.shutdown; handle getters
+python/ray/serve/context.py get_deployment_handle).
+"""
+from __future__ import annotations
+
+import time
+
+import ray_tpu
+from ray_tpu.actor import ActorClass
+from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import Application
+from ray_tpu.serve.handle import DeploymentHandle, _Router
+from ray_tpu.serve.proxy import HTTPProxy
+
+_proxy: HTTPProxy | None = None
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    handle = ActorClass(ServeController, num_cpus=0.1, name=CONTROLLER_NAME).remote()
+    # wait for liveness so the first deploy call doesn't race startup
+    ray_tpu.get(handle.list_applications.remote(), timeout=60)
+    return handle
+
+
+def start(http_options: HTTPOptions | dict | None = None) -> None:
+    """Start serve system actors (controller + HTTP proxy)
+    (reference: serve.start)."""
+    global _proxy
+    _get_or_create_controller()
+    if http_options is not None and _proxy is None:
+        if isinstance(http_options, dict):
+            http_options = HTTPOptions(**http_options)
+        _proxy = HTTPProxy(http_options)
+        _proxy.start()
+
+
+def run(
+    target: Application,
+    *,
+    name: str = "default",
+    route_prefix: str | None = None,
+    _blocking: bool = True,
+    timeout_s: float = 120.0,
+) -> DeploymentHandle:
+    """Deploy an application and (by default) block until healthy
+    (reference: serve.run api.py:479)."""
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects Deployment.bind(...)")
+    controller = _get_or_create_controller()
+    apps = target.flatten()
+    specs = [a.build_spec(name) for a in apps]
+    seen = set()
+    uniq = []
+    for s in specs:
+        if s["name"] in seen:
+            continue
+        seen.add(s["name"])
+        uniq.append(s)
+    ingress = target.deployment.name
+    ray_tpu.get(
+        controller.deploy_application.remote(name, uniq, ingress, route_prefix),
+        timeout=60,
+    )
+    _Router.reset_all()  # drop stale routing tables from a previous version
+    if route_prefix is not None and _proxy is not None:
+        _proxy.set_route(route_prefix, name, ingress)
+    if _blocking:
+        _wait_healthy(controller, name, timeout_s)
+    return DeploymentHandle(ingress, name)
+
+
+def _wait_healthy(controller, app_name: str, timeout_s: float) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = ray_tpu.get(controller.status.remote(), timeout=60)
+        app = st.get(app_name, {})
+        if app and all(d["status"] == "HEALTHY" for d in app.values()):
+            return
+        bad = [
+            f"{n}: {d['message']}" for n, d in app.items() if d["status"] == "UNHEALTHY"
+        ]
+        if bad:
+            raise RuntimeError(f"app {app_name} unhealthy: {bad}")
+        time.sleep(0.1)
+    raise TimeoutError(f"app {app_name} not healthy within {timeout_s}s: {st}")
+
+
+def status() -> dict:
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.status.remote(), timeout=60)
+
+
+def delete(name: str) -> None:
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+    if _proxy is not None:
+        _proxy.remove_routes_for_app(name)
+    _Router.reset_all()
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = _get_or_create_controller()
+    table = ray_tpu.get(controller.get_routing_table.remote(), timeout=60)
+    app = table["apps"].get(name)
+    if app is None:
+        raise ValueError(f"no serve application named {name!r}")
+    return DeploymentHandle(app["ingress"], name)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def shutdown() -> None:
+    """Tear down all serve state (reference: serve.shutdown)."""
+    global _proxy
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        controller = None
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.shutdown.remote(), timeout=60)
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
+        ray_tpu.kill(controller)
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None
+    _Router.reset_all()

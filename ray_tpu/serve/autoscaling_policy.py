@@ -1,0 +1,70 @@
+"""Replica autoscaling policy — pure math, unit-testable.
+
+Equivalent of the reference's serve autoscaling policy
+(reference: python/ray/serve/_private/autoscaling_policy.py:12
+calculate_desired_num_replicas, :78 smoothing/bounds).
+"""
+from __future__ import annotations
+
+import math
+
+from ray_tpu.serve.config import AutoscalingConfig
+
+
+def calculate_desired_num_replicas(
+    config: AutoscalingConfig,
+    total_ongoing_requests: float,
+    current_num_replicas: int,
+) -> int:
+    """Desired replicas from aggregate in-flight load.
+
+    desired = current * (per-replica load / target), smoothed separately for
+    up- and down-scaling, clamped to [min, max].
+    """
+    if current_num_replicas <= 0:
+        # scale-from-zero: enough replicas to cover the queue at target load
+        raw = total_ongoing_requests / max(config.target_ongoing_requests, 1e-9)
+        desired = math.ceil(raw)
+    else:
+        per_replica = total_ongoing_requests / current_num_replicas
+        error_ratio = per_replica / max(config.target_ongoing_requests, 1e-9)
+        smoothing = (
+            config.upscale_smoothing_factor
+            if error_ratio >= 1.0
+            else config.downscale_smoothing_factor
+        )
+        # move a `smoothing` fraction of the way toward the raw target
+        raw = current_num_replicas * (1.0 + (error_ratio - 1.0) * smoothing)
+        desired = math.ceil(raw) if error_ratio >= 1.0 else math.floor(raw)
+    return max(config.min_replicas, min(config.max_replicas, desired))
+
+
+class AutoscalingDecider:
+    """Debounces policy output: act only after N consecutive periods agree
+    (reference: upscale_delay_s/downscale_delay_s)."""
+
+    def __init__(self, config: AutoscalingConfig):
+        self.config = config
+        self._pending_direction = 0
+        self._streak = 0
+
+    def decide(self, total_ongoing: float, current: int) -> int:
+        desired = calculate_desired_num_replicas(self.config, total_ongoing, current)
+        direction = (desired > current) - (desired < current)
+        if direction == 0:
+            self._streak = 0
+            return current
+        if direction != self._pending_direction:
+            self._pending_direction = direction
+            self._streak = 1
+        else:
+            self._streak += 1
+        needed = (
+            self.config.upscale_delay_periods
+            if direction > 0
+            else self.config.downscale_delay_periods
+        )
+        if self._streak >= needed:
+            self._streak = 0
+            return desired
+        return current

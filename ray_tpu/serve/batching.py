@@ -1,0 +1,138 @@
+"""Dynamic batching — marker decorator + shape-bucket padding helpers.
+
+Equivalent of the reference's @serve.batch (reference: python/ray/serve/
+batching.py:337 _BatchQueue coalescing). Architectural deviation, TPU-first:
+our replicas execute one method at a time (ordered actor queue), so batching
+happens in the ROUTER — calls are coalesced client-side and shipped as one
+actor task. This also lets the batcher pad to fixed size buckets so a jitted
+TPU model sees a closed set of batch shapes (no XLA recompiles), which the
+reference's batcher cannot do (SURVEY.md §7 hard parts: shape-aware batching).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable
+
+from ray_tpu.serve.config import BatchConfig
+
+_BATCH_ATTR = "__rt_serve_batch__"
+
+
+def batch(
+    _func: Callable | None = None,
+    *,
+    max_batch_size: int = 8,
+    batch_wait_timeout_s: float = 0.01,
+    size_buckets: tuple[int, ...] | None = None,
+):
+    """Mark a deployment method as batched: the router coalesces up to
+    ``max_batch_size`` concurrent calls (waiting at most
+    ``batch_wait_timeout_s``) and the method receives a LIST of the single
+    call payloads, returning a list of results in order.
+    """
+
+    def wrap(func):
+        setattr(
+            func,
+            _BATCH_ATTR,
+            BatchConfig(
+                max_batch_size=max_batch_size,
+                batch_wait_timeout_s=batch_wait_timeout_s,
+                size_buckets=size_buckets,
+            ),
+        )
+        return func
+
+    return wrap if _func is None else wrap(_func)
+
+
+def get_batch_config(func) -> BatchConfig | None:
+    return getattr(func, _BATCH_ATTR, None)
+
+
+def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    """Smallest bucket >= n (last bucket if none fits)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class RouterBatcher:
+    """Client-side coalescer for one (deployment, method).
+
+    submit() returns a Future resolved with that call's single result once
+    the flushed actor call completes. Flush happens when max_batch_size
+    accumulate or the oldest call has waited batch_wait_timeout_s.
+    """
+
+    def __init__(self, config: BatchConfig, flush_fn: Callable[[list], list]):
+        self._config = config
+        # a batch may never exceed the largest bucket, or the padded-shape
+        # guarantee breaks (an oversized batch would ship unpadded)
+        self._max_batch = config.max_batch_size
+        if config.size_buckets:
+            self._max_batch = min(self._max_batch, config.size_buckets[-1])
+        self._flush_fn = flush_fn  # list[payload] -> list[result] (blocking)
+        self._lock = threading.Lock()
+        self._pending: list[tuple[Any, Future]] = []
+        self._timer: threading.Timer | None = None
+
+    def submit(self, payload: Any) -> Future:
+        fut: Future = Future()
+        flush_now = None
+        with self._lock:
+            self._pending.append((payload, fut))
+            if len(self._pending) >= self._max_batch:
+                flush_now = self._take_locked()
+            elif self._timer is None:
+                self._timer = threading.Timer(
+                    self._config.batch_wait_timeout_s, self._flush_timeout
+                )
+                self._timer.daemon = True
+                self._timer.start()
+        if flush_now:
+            self._run_flush(flush_now)
+        return fut
+
+    def _take_locked(self) -> list[tuple[Any, Future]]:
+        batch_items, self._pending = self._pending, []
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        return batch_items
+
+    def _flush_timeout(self) -> None:
+        with self._lock:
+            items = self._take_locked()
+        if items:
+            self._run_flush(items)
+
+    def _run_flush(self, items: list[tuple[Any, Future]]) -> None:
+        def work():
+            payloads = [p for p, _ in items]
+            n = len(payloads)
+            if self._config.size_buckets:
+                target = pad_to_bucket(n, self._config.size_buckets)
+                payloads = payloads + [None] * (target - n)
+            try:
+                results = self._flush_fn(payloads)
+            except Exception as e:  # noqa: BLE001 — fan the error out
+                for _, f in items:
+                    f.set_exception(e)
+                return
+            for (_, f), r in zip(items, results):
+                f.set_result(r)
+
+        threading.Thread(target=work, daemon=True).start()
+
+    def flush_and_wait(self, deadline: float) -> None:
+        """Test/shutdown helper: force a flush, wait for pending futures."""
+        with self._lock:
+            items = self._take_locked()
+        if items:
+            self._run_flush(items)
+        for _, f in items:
+            f.result(timeout=max(0.0, deadline - time.monotonic()))

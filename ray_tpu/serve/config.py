@@ -1,0 +1,78 @@
+"""Serve configuration dataclasses.
+
+Equivalent of the reference's Serve config surface
+(reference: python/ray/serve/config.py — DeploymentConfig/AutoscalingConfig;
+python/ray/serve/schema.py pydantic schemas). Plain dataclasses here: the
+validation surface is small and pydantic is not load-bearing for behavior.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AutoscalingConfig:
+    """Replica autoscaling targets
+    (reference: serve/config.py AutoscalingConfig; policy math in
+    serve/_private/autoscaling_policy.py:12 calculate_desired_num_replicas).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_smoothing_factor: float = 1.0
+    downscale_smoothing_factor: float = 0.5
+    # consecutive decisions required before acting (reference: upscale_delay_s/
+    # downscale_delay_s expressed in loop periods)
+    upscale_delay_periods: int = 1
+    downscale_delay_periods: int = 3
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"need 0 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}/{self.max_replicas}"
+            )
+
+
+@dataclass
+class BatchConfig:
+    """Router-side dynamic batching for one replica method.
+
+    TPU-first deviation from the reference: the reference batches inside the
+    replica's asyncio loop (serve/batching.py:337) with arbitrary resulting
+    batch sizes; here the router coalesces and can pad to fixed bucket sizes
+    so a jitted model never sees a new shape (XLA recompile avoidance —
+    SURVEY.md §7 "the router/batcher must be shape-aware").
+    """
+
+    max_batch_size: int = 8
+    batch_wait_timeout_s: float = 0.01
+    # optional ascending bucket sizes; router pads submitted batch lists to
+    # the next bucket with `None` entries which the replica wrapper strips
+    # after the model call (shape-stable submission)
+    size_buckets: tuple[int, ...] | None = None
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    autoscaling_config: AutoscalingConfig | None = None
+    # actor resources per replica (TPU chips ride here: {"TPU": 1})
+    ray_actor_options: dict = field(default_factory=dict)
+    health_check_period_s: float = 1.0
+    graceful_shutdown_timeout_s: float = 5.0
+    user_config: dict | None = None
+
+    @property
+    def target_num_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            return self.autoscaling_config.min_replicas
+        return self.num_replicas
+
+
+@dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000

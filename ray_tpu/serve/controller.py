@@ -1,0 +1,403 @@
+"""ServeController — the deployment reconciler actor.
+
+Equivalent of the reference's ServeController + DeploymentStateManager
+(reference: python/ray/serve/_private/controller.py:88 controller actor;
+deployment_state.py:1155,2258 replica-set reconciler state machine;
+application_state.py app lifecycle; autoscaling decisions fed by replica
+metrics). One named actor; a background thread drives reconciliation:
+desired replicas vs. live replicas, health checks, autoscaling.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import ray_tpu
+from ray_tpu.actor import ActorClass
+from ray_tpu.serve.autoscaling_policy import AutoscalingDecider
+from ray_tpu.serve.config import DeploymentConfig
+from ray_tpu.serve.replica import ReplicaActor
+
+CONTROLLER_NAME = "RT_SERVE_CONTROLLER"
+_METRIC_TTL_S = 5.0
+
+
+class _ReplicaState:
+    def __init__(self, handle):
+        self.handle = handle
+        self.actor_id = handle._actor_id
+        self.state = "STARTING"  # STARTING | RUNNING | STOPPING
+        self.started_at = time.monotonic()
+        self.ping_ref = None
+        self.ping_deadline = 0.0
+        self.next_ping_at = 0.0
+
+
+# consecutive replica deaths before __rt first became RUNNING that flip the
+# deployment UNHEALTHY and stop the respawn loop (reference: deployment_state
+# CrashLoopBackoff / DEPLOY_FAILED)
+_MAX_CONSECUTIVE_START_FAILURES = 3
+
+
+class _DeploymentState:
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.config: DeploymentConfig = spec["config"]
+        self.target = self.config.target_num_replicas
+        self.replicas: list[_ReplicaState] = []
+        self.batch_configs: dict[str, dict] = {}
+        self.decider = (
+            AutoscalingDecider(self.config.autoscaling_config)
+            if self.config.autoscaling_config
+            else None
+        )
+        self.status = "UPDATING"  # UPDATING | HEALTHY | UNHEALTHY
+        self.last_error: str | None = None
+        self.consecutive_start_failures = 0
+        self.deleted = False
+
+
+class ServeController:
+    """State-reconciling controller (runs as a named actor; methods are the
+    RPC surface, a daemon thread is the control loop)."""
+
+    def __init__(self, reconcile_period_s: float = 0.2):
+        self._lock = threading.RLock()
+        # app_name -> {"deployments": {name: _DeploymentState}, "ingress": str,
+        #              "route_prefix": str|None}
+        self._apps: dict[str, dict] = {}
+        self._version = 0
+        # router_id -> (ts, {(app, deployment): inflight})
+        self._router_metrics: dict[str, tuple[float, dict]] = {}
+        self._stopped = threading.Event()
+        self._reconcile_period_s = reconcile_period_s
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconciler"
+        )
+        self._thread.start()
+
+    # ---------------- RPC surface ----------------
+
+    def deploy_application(
+        self,
+        app_name: str,
+        deployment_specs: list[dict],
+        ingress: str,
+        route_prefix: str | None,
+    ) -> None:
+        """Set target state for an app (reference: controller.py:635
+        deploy_application → reconciler convergence)."""
+        with self._lock:
+            old = self._apps.get(app_name, {"deployments": {}})
+            new_deps: dict[str, _DeploymentState] = {}
+            removed: list[_DeploymentState] = []
+            for spec in deployment_specs:
+                name = spec["name"]
+                prev = old["deployments"].get(name)
+                ds = _DeploymentState(spec)
+                if prev is not None:
+                    if self._same_spec(prev.spec, spec):
+                        ds.replicas = prev.replicas  # adopt live replicas
+                        ds.batch_configs = prev.batch_configs
+                        if prev.decider is not None and ds.decider is not None:
+                            ds.decider = prev.decider
+                    else:
+                        # spec changed: the old replicas run stale code —
+                        # they must die, not leak
+                        removed.append(prev)
+                new_deps[name] = ds
+            removed.extend(
+                d for n, d in old["deployments"].items() if n not in new_deps
+            )
+            for d in removed:
+                d.deleted = True
+            self._apps[app_name] = {
+                "deployments": new_deps,
+                "ingress": ingress,
+                "route_prefix": route_prefix,
+            }
+            self._version += 1
+        for d in removed:
+            self._stop_replicas(d, len(d.replicas))
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            app = self._apps.pop(app_name, None)
+            self._version += 1
+            if app:
+                for d in app["deployments"].values():
+                    d.deleted = True
+        if app:
+            for d in app["deployments"].values():
+                self._stop_replicas(d, len(d.replicas))
+
+    def list_applications(self) -> list[str]:
+        with self._lock:
+            return list(self._apps)
+
+    def get_routing_table(
+        self, router_id: str | None = None, metrics: dict | None = None
+    ) -> dict:
+        """Routing snapshot for handles/proxies; piggybacks router load
+        metrics for autoscaling (reference: long-poll config push,
+        serve/_private/long_poll.py — ours is versioned pull)."""
+        if router_id is not None and metrics is not None:
+            with self._lock:
+                self._router_metrics[router_id] = (
+                    time.monotonic(),
+                    {tuple(k): v for k, v in metrics.items()},
+                )
+        out: dict[str, Any] = {"version": None, "apps": {}}
+        with self._lock:
+            out["version"] = self._version
+            for app_name, app in self._apps.items():
+                deps = {}
+                for name, ds in app["deployments"].items():
+                    deps[name] = {
+                        "replicas": [
+                            r.handle for r in ds.replicas if r.state == "RUNNING"
+                        ],
+                        "max_ongoing_requests": ds.config.max_ongoing_requests,
+                        "batch_configs": ds.batch_configs,
+                    }
+                out["apps"][app_name] = {
+                    "ingress": app["ingress"],
+                    "route_prefix": app["route_prefix"],
+                    "deployments": deps,
+                }
+        return out
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                app_name: {
+                    name: {
+                        "status": ds.status,
+                        "target_replicas": ds.target,
+                        "running_replicas": sum(
+                            1 for r in ds.replicas if r.state == "RUNNING"
+                        ),
+                        "message": ds.last_error or "",
+                    }
+                    for name, ds in app["deployments"].items()
+                }
+                for app_name, app in self._apps.items()
+            }
+
+    def shutdown(self) -> None:
+        self._stopped.set()
+        with self._lock:
+            apps = list(self._apps.values())
+            self._apps.clear()
+        for app in apps:
+            for d in app["deployments"].values():
+                self._stop_replicas(d, len(d.replicas))
+
+    # ---------------- reconciliation ----------------
+
+    @staticmethod
+    def _same_spec(a: dict, b: dict) -> bool:
+        return (
+            a["callable_blob"] == b["callable_blob"]
+            and a["init_args"] == b["init_args"]
+            and a["init_kwargs"] == b["init_kwargs"]
+            and a["config"] == b["config"]
+        )
+
+    def _reconcile_loop(self) -> None:
+        while not self._stopped.wait(self._reconcile_period_s):
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                import traceback
+
+                traceback.print_exc()
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            work = [
+                (app_name, name, ds)
+                for app_name, app in self._apps.items()
+                for name, ds in app["deployments"].items()
+            ]
+        changed = False
+        for app_name, name, ds in work:
+            changed |= self._reconcile_deployment(app_name, name, ds)
+        if changed:
+            with self._lock:
+                self._version += 1
+
+    def _reconcile_deployment(self, app_name: str, name: str, ds: _DeploymentState) -> bool:
+        changed = False
+        worker = ray_tpu.worker.global_worker()
+        # 1. promote STARTING replicas that came alive; drop dead ones.
+        # GCS reads happen outside the lock; list mutations under it.
+        for r in list(ds.replicas):
+            try:
+                info = worker.gcs.call(
+                    "get_actor", {"actor_id": r.actor_id.binary()}
+                )["actor"]
+            except Exception:
+                continue
+            state = (info or {}).get("state")
+            if state == "ALIVE" and r.state == "STARTING":
+                try:
+                    batch_cfgs = ray_tpu.get(
+                        r.handle.batch_configs.remote(), timeout=30
+                    )
+                    with self._lock:
+                        ds.batch_configs = batch_cfgs
+                        r.state = "RUNNING"
+                        ds.consecutive_start_failures = 0
+                    changed = True
+                except Exception as e:  # noqa: BLE001
+                    ds.last_error = f"replica probe failed: {e}"
+            elif state == "DEAD":
+                with self._lock:
+                    if r in ds.replicas:
+                        ds.replicas.remove(r)
+                    if r.state == "STARTING":
+                        ds.consecutive_start_failures += 1
+                    ds.last_error = "replica actor died"
+                changed = True
+        # 2. health-check RUNNING replicas via ping round-trips
+        changed |= self._health_check(ds)
+        # 3. crash-loop detection: repeated death-before-RUNNING means the
+        # user code fails at startup — stop respawning, mark UNHEALTHY
+        if ds.consecutive_start_failures >= _MAX_CONSECUTIVE_START_FAILURES:
+            if ds.status != "UNHEALTHY":
+                with self._lock:
+                    ds.status = "UNHEALTHY"
+                    ds.last_error = (
+                        f"{ds.consecutive_start_failures} consecutive replicas "
+                        f"died before becoming ready: {ds.last_error or ''}"
+                    )
+                return True
+            return False
+        # 4. autoscaling decision from router-reported load
+        if ds.decider is not None:
+            total = self._aggregate_inflight(app_name, name)
+            running = sum(1 for r in ds.replicas if r.state == "RUNNING")
+            if running > 0 or total > 0:
+                new_target = ds.decider.decide(total, ds.target)
+                if new_target != ds.target:
+                    with self._lock:
+                        ds.target = new_target
+                    changed = True
+        # 5. converge replica count
+        with self._lock:
+            live = [r for r in ds.replicas if r.state in ("STARTING", "RUNNING")]
+            deficit = ds.target - len(live) if not ds.deleted else 0
+            excess = len(live) - ds.target if not ds.deleted else 0
+        if deficit > 0:
+            for _ in range(deficit):
+                self._start_replica(app_name, ds)
+                changed = True
+        elif excess > 0:
+            self._stop_replicas(ds, excess)
+            changed = True
+        # 6. status rollup
+        with self._lock:
+            running = sum(1 for r in ds.replicas if r.state == "RUNNING")
+            new_status = "HEALTHY" if running >= ds.target else "UPDATING"
+            if new_status != ds.status:
+                ds.status = new_status
+                changed = True
+        return changed
+
+    def _health_check(self, ds: _DeploymentState) -> bool:
+        """Ping RUNNING replicas (reference: deployment_state health-check
+        loop driving user check_health via the replica actor). A replica
+        whose ping doesn't land within 3 periods is killed and replaced by
+        the convergence step."""
+        period = ds.config.health_check_period_s
+        if period <= 0:
+            return False
+        now = time.monotonic()
+        changed = False
+        worker = ray_tpu.worker.global_worker()
+        for r in list(ds.replicas):
+            if r.state != "RUNNING":
+                continue
+            if r.ping_ref is not None:
+                done = worker.store.contains(r.ping_ref.object_id)
+                if done:
+                    try:
+                        ray_tpu.get(r.ping_ref, timeout=1)
+                        r.ping_ref = None
+                        r.next_ping_at = now + period
+                    except Exception as e:  # noqa: BLE001 — failed check
+                        self._kill_unhealthy(ds, r, f"health check failed: {e}")
+                        changed = True
+                elif now > r.ping_deadline:
+                    self._kill_unhealthy(ds, r, "health check timed out")
+                    changed = True
+            elif now >= r.next_ping_at:
+                try:
+                    r.ping_ref = r.handle.ping.remote()
+                    r.ping_deadline = now + 3 * period
+                except Exception:  # noqa: BLE001 — dead; step 1 reaps it
+                    pass
+        return changed
+
+    def _kill_unhealthy(self, ds: _DeploymentState, r, reason: str) -> None:
+        with self._lock:
+            if r in ds.replicas:
+                ds.replicas.remove(r)
+            ds.last_error = reason
+        try:
+            ray_tpu.kill(r.handle)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _aggregate_inflight(self, app_name: str, dep_name: str) -> float:
+        now = time.monotonic()
+        total = 0.0
+        with self._lock:
+            for rid, (ts, m) in list(self._router_metrics.items()):
+                if now - ts > _METRIC_TTL_S:
+                    del self._router_metrics[rid]
+                    continue
+                total += m.get((app_name, dep_name), 0.0)
+        return total
+
+    def _start_replica(self, app_name: str, ds: _DeploymentState) -> None:
+        spec = ds.spec
+        opts = dict(ds.config.ray_actor_options)
+        actor_cls = ActorClass(
+            ReplicaActor,
+            num_cpus=opts.pop("num_cpus", 1),
+            num_tpus=opts.pop("num_tpus", 0),
+            resources=opts.pop("resources", None),
+            max_restarts=0,  # the reconciler owns restarts, not the raylet
+        )
+        handle = actor_cls.remote(
+            spec["name"],
+            spec["callable_blob"],
+            spec["init_args"],
+            spec["init_kwargs"],
+            ds.config.user_config,
+        )
+        rs = _ReplicaState(handle)
+        with self._lock:
+            if ds.deleted:
+                # deleted while we were starting it — don't leak the actor
+                pass
+            else:
+                ds.replicas.append(rs)
+                return
+        try:
+            ray_tpu.kill(handle)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _stop_replicas(self, ds: _DeploymentState, n: int) -> None:
+        with self._lock:
+            victims, keep = ds.replicas[:n], ds.replicas[n:]
+            ds.replicas = keep
+        for r in victims:
+            try:
+                ray_tpu.kill(r.handle)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass

@@ -1,0 +1,243 @@
+"""DeploymentHandle + router: client-side replica scheduling.
+
+Equivalent of the reference's handle/router pair
+(reference: python/ray/serve/handle.py:298 DeploymentHandle;
+serve/_private/router.py:922 Router, :308 PowerOfTwoChoicesReplicaScheduler,
+assign_replica :278). The handle tracks its own in-flight counts per replica
+and picks the lower-loaded of two random replicas; batched methods are
+coalesced client-side (see batching.py — shape-aware, TPU-first).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Any
+
+import ray_tpu
+from ray_tpu.serve.batching import RouterBatcher
+from ray_tpu.serve.config import BatchConfig
+
+_TABLE_REFRESH_S = 0.25
+
+
+class DeploymentResponse:
+    """Result of handle.method.remote(): resolve with .result()
+    (reference: serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, ref=None, future: Future | None = None, on_done=None):
+        self._ref = ref
+        self._future = future
+        self._on_done = on_done
+        self._done = False
+
+    def result(self, timeout: float | None = 60.0) -> Any:
+        try:
+            if self._future is not None:
+                return self._future.result(timeout)
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            if not self._done:
+                self._done = True
+                if self._on_done:
+                    self._on_done()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class _Router:
+    """Shared per-process router state: routing table cache + in-flight
+    accounting + batchers. One per (app, deployment)."""
+
+    _instances: dict = {}
+    _instances_lock = threading.Lock()
+
+    @classmethod
+    def get(cls, app_name: str, deployment_name: str) -> "_Router":
+        key = (app_name, deployment_name)
+        with cls._instances_lock:
+            r = cls._instances.get(key)
+            if r is None:
+                r = cls(app_name, deployment_name)
+                cls._instances[key] = r
+            return r
+
+    @classmethod
+    def reset_all(cls) -> None:
+        with cls._instances_lock:
+            cls._instances.clear()
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.router_id = uuid.uuid4().hex[:12]
+        self._lock = threading.Lock()
+        self._replicas: list = []
+        self._batch_configs: dict[str, dict] = {}
+        self._max_ongoing = 8
+        self._inflight: dict[bytes, int] = {}  # actor_id -> count
+        self._outstanding: dict[bytes, bytes] = {}  # object_id -> actor_id
+        self._batchers: dict[str, RouterBatcher] = {}
+        self._last_refresh = 0.0
+        self._controller = None
+
+    # -- table management --
+
+    def _controller_handle(self):
+        if self._controller is None:
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+
+            self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return self._controller
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_refresh < _TABLE_REFRESH_S:
+                return
+            self._last_refresh = now
+            metrics = {
+                (self.app_name, self.deployment_name): sum(self._inflight.values())
+            }
+        table = ray_tpu.get(
+            self._controller_handle().get_routing_table.remote(
+                self.router_id, {tuple(k): v for k, v in metrics.items()}
+            ),
+            timeout=30,
+        )
+        app = table["apps"].get(self.app_name)
+        if app is None:
+            raise RuntimeError(f"serve application {self.app_name!r} not found")
+        dep = app["deployments"].get(self.deployment_name)
+        if dep is None:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} not found in app "
+                f"{self.app_name!r}"
+            )
+        with self._lock:
+            self._replicas = dep["replicas"]
+            self._batch_configs = dep["batch_configs"]
+            self._max_ongoing = dep["max_ongoing_requests"]
+
+    # -- in-flight accounting --
+
+    def _sweep_locked(self) -> None:
+        """Decrement in-flight for completed calls (store-contains poll —
+        cheap local check; avoids a callback thread per request)."""
+        worker = ray_tpu.worker.global_worker()
+        from ray_tpu._private.ids import ObjectID
+
+        for oid, aid in list(self._outstanding.items()):
+            if worker.store.contains(ObjectID(oid)):
+                del self._outstanding[oid]
+                self._inflight[aid] = max(0, self._inflight.get(aid, 1) - 1)
+
+    def _pick_replica(self, deadline: float):
+        """Power of two choices over tracked in-flight counts."""
+        while True:
+            self._refresh()
+            with self._lock:
+                replicas = list(self._replicas)
+                if replicas:
+                    self._sweep_locked()
+                    if len(replicas) == 1:
+                        return replicas[0]
+                    a, b = random.sample(replicas, 2)
+                    la = self._inflight.get(a._actor_id.binary(), 0)
+                    lb = self._inflight.get(b._actor_id.binary(), 0)
+                    return a if la <= lb else b
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no RUNNING replicas for {self.app_name}/"
+                    f"{self.deployment_name}"
+                )
+            time.sleep(0.1)
+
+    # -- call paths --
+
+    def call(self, method_name: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+        self._refresh()
+        with self._lock:
+            bc = self._batch_configs.get(method_name)
+        if bc is not None:
+            # the @serve.batch contract is one positional payload per call
+            # (the method receives the list); extra args/kwargs would be
+            # silently dropped replica-side, so reject them here
+            if len(args) != 1 or kwargs:
+                raise TypeError(
+                    f"batched method {self.deployment_name}.{method_name} "
+                    f"takes exactly one positional argument per call, got "
+                    f"args={len(args)} kwargs={sorted(kwargs)}"
+                )
+            return self._call_batched(method_name, bc, args, kwargs)
+        replica = self._pick_replica(time.monotonic() + 30)
+        ref = replica.rt_call.remote(method_name, args, kwargs)
+        aid = replica._actor_id.binary()
+        with self._lock:
+            self._inflight[aid] = self._inflight.get(aid, 0) + 1
+            self._outstanding[ref.object_id.binary()] = aid
+        return DeploymentResponse(ref=ref)
+
+    def _call_batched(
+        self, method_name: str, bc: dict, args: tuple, kwargs: dict
+    ) -> DeploymentResponse:
+        with self._lock:
+            batcher = self._batchers.get(method_name)
+            if batcher is None:
+
+                def flush(payloads, _m=method_name):
+                    replica = self._pick_replica(time.monotonic() + 30)
+                    aid = replica._actor_id.binary()
+                    with self._lock:
+                        n_real = sum(1 for p in payloads if p is not None)
+                        self._inflight[aid] = self._inflight.get(aid, 0) + n_real
+                    try:
+                        ref = replica.rt_batched.remote(_m, payloads)
+                        return ray_tpu.get(ref, timeout=120)
+                    finally:
+                        with self._lock:
+                            self._inflight[aid] = max(
+                                0, self._inflight.get(aid, n_real) - n_real
+                            )
+
+                batcher = RouterBatcher(BatchConfig(**bc), flush)
+                self._batchers[method_name] = batcher
+        fut = batcher.submit((args, kwargs))
+        return DeploymentResponse(future=fut)
+
+
+class _HandleMethod:
+    def __init__(self, router: _Router, method_name: str):
+        self._router = router
+        self._method_name = method_name
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._router.call(self._method_name, args, kwargs)
+
+
+class DeploymentHandle:
+    """Callable handle to a deployment; picklable (rebuilds its router from
+    the named controller on the other side)."""
+
+    def __init__(self, deployment_name: str, app_name: str = "default"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.app_name))
+
+    @property
+    def _router(self) -> _Router:
+        return _Router.get(self.app_name, self.deployment_name)
+
+    def __getattr__(self, name: str) -> _HandleMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _HandleMethod(self._router, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._router.call("__call__", args, kwargs)

@@ -1,0 +1,122 @@
+"""HTTP ingress proxy (aiohttp) routing to deployment handles.
+
+Equivalent of the reference's per-node HTTPProxy
+(reference: python/ray/serve/_private/proxy.py:896,975 uvicorn ASGI proxy,
+proxy_request :364 → Router.assign_replica). Ours is an aiohttp server in a
+daemon thread; request JSON bodies become the single call payload and
+handler results are returned as JSON.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any
+
+import ray_tpu
+from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class HTTPProxy:
+    def __init__(self, options: HTTPOptions):
+        self.options = options
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._runner = None
+        self._started = threading.Event()
+        self._start_error: Exception | None = None
+        # route_prefix -> (app_name, ingress deployment)
+        self._routes: dict[str, tuple[str, str]] = {}
+        self._routes_lock = threading.Lock()
+
+    # -- route table --
+
+    def set_route(self, route_prefix: str, app_name: str, ingress: str) -> None:
+        with self._routes_lock:
+            self._routes[route_prefix.rstrip("/") or "/"] = (app_name, ingress)
+
+    def remove_routes_for_app(self, app_name: str) -> None:
+        with self._routes_lock:
+            self._routes = {
+                k: v for k, v in self._routes.items() if v[0] != app_name
+            }
+
+    def _match(self, path: str) -> tuple[str, str] | None:
+        with self._routes_lock:
+            best = None
+            for prefix, target in self._routes.items():
+                if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+                    if best is None or len(prefix) > len(best[0]):
+                        best = (prefix, target)
+            return best[1] if best else None
+
+    # -- server --
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._serve_thread, daemon=True, name="serve-http-proxy"
+        )
+        self._thread.start()
+        if not self._started.wait(15):
+            raise RuntimeError("HTTP proxy failed to start in time")
+        if self._start_error is not None:
+            raise self._start_error
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+    def _serve_thread(self) -> None:
+        from aiohttp import web
+
+        async def handler(request: web.Request) -> web.Response:
+            target = self._match(request.path)
+            if target is None:
+                return web.json_response(
+                    {"error": f"no app routes {request.path}"}, status=404
+                )
+            app_name, ingress = target
+            if request.can_read_body:
+                raw = await request.read()
+                try:
+                    payload: Any = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    payload = raw.decode()
+            else:
+                payload = dict(request.query) or None
+            # The whole call (routing included) runs in the executor: the
+            # router does blocking controller RPCs and may sleep waiting for
+            # replicas, which must never stall the event loop.
+            def call_blocking():
+                handle = DeploymentHandle(ingress, app_name)
+                return handle.remote(payload).result(timeout=120)
+
+            try:
+                result = await asyncio.get_event_loop().run_in_executor(
+                    None, call_blocking
+                )
+            except Exception as e:  # noqa: BLE001 — surface to the client
+                return web.json_response({"error": str(e)}, status=500)
+            if isinstance(result, (dict, list, str, int, float, bool, type(None))):
+                return web.json_response({"result": result})
+            return web.json_response({"result": repr(result)})
+
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handler)
+        runner = web.AppRunner(app)
+        try:
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.options.host, self.options.port)
+            loop.run_until_complete(site.start())
+        except Exception as e:  # noqa: BLE001 — report to starter
+            self._start_error = e
+            self._started.set()
+            return
+        self._runner = runner
+        self._started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())

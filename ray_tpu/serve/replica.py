@@ -1,0 +1,127 @@
+"""Replica actor wrapper around the user's deployment callable.
+
+Equivalent of the reference's RayServeReplica
+(reference: python/ray/serve/_private/replica.py — user-code wrapper actor;
+health check + reconfigure surface). The wrapper resolves deployment-handle
+placeholder args (model composition), dispatches plain and batched calls,
+and reports lifecycle state.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import ray_tpu
+from ray_tpu._private import task_spec as ts
+from ray_tpu.serve.batching import get_batch_config
+
+
+class HandleArg:
+    """Placeholder for a DeploymentHandle argument, resolved replica-side
+    (model composition: Model.bind(other_app) — reference:
+    serve/_private/deployment_graph_build.py)."""
+
+    def __init__(self, deployment_name: str, app_name: str):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+
+
+def _resolve_handle_args(value):
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    if isinstance(value, HandleArg):
+        return DeploymentHandle(value.deployment_name, value.app_name)
+    if isinstance(value, (list, tuple)):
+        return type(value)(_resolve_handle_args(v) for v in value)
+    if isinstance(value, dict):
+        return {k: _resolve_handle_args(v) for k, v in value.items()}
+    return value
+
+
+class ReplicaActor:
+    """One serving replica. Created by the controller with the serialized
+    user callable; methods are invoked by routers via rt_call /
+    rt_batched (ordered actor tasks — one at a time, which is the right
+    default for a TPU-bound model: the chip runs one program anyway)."""
+
+    def __init__(
+        self,
+        deployment_name: str,
+        callable_blob: bytes,
+        init_args: tuple,
+        init_kwargs: dict,
+        user_config: dict | None = None,
+    ):
+        self.deployment_name = deployment_name
+        factory = ts.loads_function(callable_blob)
+        init_args = _resolve_handle_args(init_args)
+        init_kwargs = _resolve_handle_args(init_kwargs)
+        if inspect.isclass(factory):
+            self._instance = factory(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._instance = factory
+            self._is_function = True
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- control surface --
+
+    def ping(self) -> str:
+        """Liveness probe (reference: replica health check)."""
+        check = getattr(self._instance, "check_health", None)
+        if check is not None and not self._is_function:
+            check()
+        return "ok"
+
+    def reconfigure(self, user_config: dict) -> None:
+        fn = getattr(self._instance, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    def batch_configs(self) -> dict[str, dict]:
+        """method name -> BatchConfig fields, discovered from markers."""
+        out = {}
+        target = self._instance if not self._is_function else None
+        if target is None:
+            cfg = get_batch_config(self._instance)
+            if cfg is not None:
+                out["__call__"] = cfg.__dict__
+            return out
+        for name, member in inspect.getmembers(target, callable):
+            if name.startswith("_") and name != "__call__":
+                continue
+            cfg = get_batch_config(member)
+            if cfg is not None:
+                out[name] = cfg.__dict__
+        return out
+
+    # -- data surface --
+
+    def rt_call(self, method_name: str, args: tuple, kwargs: dict):
+        return self._method(method_name)(*args, **kwargs)
+
+    def rt_batched(self, method_name: str, payloads: list):
+        """Batched dispatch: payloads is a list of (args, kwargs) —
+        possibly padded with None by the router's shape bucketing. The user
+        method receives the list of first positional args (the reference's
+        @serve.batch contract) and returns a list of results."""
+        real = [p for p in payloads if p is not None]
+        items = [a[0] for a, _k in real]  # router enforces 1 positional arg
+        results = self._method(method_name)(items)
+        if len(results) != len(real):
+            raise ValueError(
+                f"batched method {method_name} returned {len(results)} results "
+                f"for {len(real)} inputs"
+            )
+        return list(results)
+
+    def _method(self, name: str):
+        if self._is_function:
+            if name != "__call__":
+                raise AttributeError(
+                    f"function deployment {self.deployment_name} only supports "
+                    f"__call__, got {name}"
+                )
+            return self._instance
+        return getattr(self._instance, name)
