@@ -152,8 +152,19 @@ class ObjectStoreClient:
         with self._map_lock:
             self._mappings.pop(key, None)  # dropped, not closed: readers may
             #                                still hold exported views
+            old_pending = self._pending_creates.pop(key, None)
             self._pending_creates[key] = m
+        if old_pending is not None:
+            old_pending.close()  # abandoned earlier create by this process
         return m.buf
+
+    def discard_pending(self, object_id: ObjectID) -> None:
+        """Drop a created-but-never-sealed mapping (failed write/seal path);
+        without this, aborted puts leak writable mmaps outside the LRU cap."""
+        with self._map_lock:
+            m = self._pending_creates.pop(object_id.binary(), None)
+        if m is not None:
+            m.close()
 
     def seal(self, object_id: ObjectID) -> None:
         st, _ = self._request(OP_SEAL, object_id.binary())

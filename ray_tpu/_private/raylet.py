@@ -751,6 +751,10 @@ class Raylet:
             except ValueError:
                 pass  # already exists (duplicate failure path) — keep first
             except Exception:
+                try:
+                    self.store.discard_pending(oid)
+                except Exception:  # noqa: BLE001 — connection already gone
+                    pass
                 if self._stopped.is_set():
                     return  # store already torn down; nobody will get() this
                 # e.g. store full: dropping the error would hang the owner's

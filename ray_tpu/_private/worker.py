@@ -80,6 +80,11 @@ class CoreWorker:
         self._notify_handlers: dict[str, list] = {}
         self._current_chips: list[int] = []
         self.current_actor_id: ActorID | None = None
+        from ray_tpu._private.task_events import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer(
+            self.gcs, self.worker_id.hex(), node_id.hex()
+        )
 
     # ---------------- notifications ----------------
 
@@ -111,8 +116,12 @@ class CoreWorker:
             # deterministic id (its crashed predecessor sealed it first) —
             # idempotent success, keep the existing object.
             return
-        ser.write_chunks(chunks, buf)
-        self.store.seal(oid)
+        try:
+            ser.write_chunks(chunks, buf)
+            self.store.seal(oid)
+        except BaseException:
+            self.store.discard_pending(oid)
+            raise
 
     def get(self, refs: ObjectRef | Sequence[ObjectRef], timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -242,6 +251,10 @@ class CoreWorker:
     def submit_task(self, spec: dict) -> list[ObjectRef]:
         """Submit a normal or actor-creation task to the local raylet."""
         refs = [ObjectRef(o) for o in ts.return_object_ids(spec)]
+        self.task_events.record(
+            task_id=spec["task_id"], job_id=spec["job_id"], name=spec["name"],
+            event="SUBMITTED", task_type=spec["type"],
+        )
         with self._task_lock:
             for r in refs:
                 self._lineage[r.object_id.binary()] = spec
@@ -305,6 +318,11 @@ class CoreWorker:
         prev_task = self.task_id
         self.task_id = TaskID(spec["task_id"])
         self._current_chips = chips
+        self.task_events.record(
+            task_id=spec["task_id"], job_id=spec["job_id"], name=spec["name"],
+            event="RUNNING", task_type=spec["type"],
+        )
+        self._last_task_failed = False
         try:
             if spec["type"] == ts.ACTOR_CREATION:
                 self._execute_actor_creation(spec)
@@ -313,6 +331,12 @@ class CoreWorker:
             else:
                 self._execute_normal(spec)
         finally:
+            self.task_events.record(
+                task_id=spec["task_id"], job_id=spec["job_id"],
+                name=spec["name"],
+                event="FAILED" if self._last_task_failed else "FINISHED",
+                task_type=spec["type"],
+            )
             self.task_id = prev_task
             self.raylet.call("task_done", {})
 
@@ -344,7 +368,10 @@ class CoreWorker:
             except ValueError:
                 pass  # duplicate execution (retry landed first) — keep first
 
+    _last_task_failed = False
+
     def _store_error(self, spec: dict, exc: Exception) -> None:
+        self._last_task_failed = True
         err = TaskError.from_exception(spec["name"], exc)
         for oid in ts.return_object_ids(spec):
             try:
@@ -387,6 +414,13 @@ class CoreWorker:
             )
         except Exception as e:  # noqa: BLE001
             self._store_error(spec, e)
+            # record the terminal event NOW: os._exit skips every finally
+            # and the buffer's flush thread
+            self.task_events.record(
+                task_id=spec["task_id"], job_id=spec["job_id"],
+                name=spec["name"], event="FAILED", task_type=spec["type"],
+            )
+            self.task_events.stop()
             # leave the actor unstarted; raylet worker-death/timeout paths
             # surface the failure to callers
             os._exit(1)
@@ -403,6 +437,7 @@ class CoreWorker:
     # ---------------- shutdown ----------------
 
     def shutdown(self) -> None:
+        self.task_events.stop()
         for c in self._actor_raylet_clients.values():
             c.close()
         self.gcs.close()

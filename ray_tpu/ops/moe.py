@@ -1,0 +1,121 @@
+"""Mixture-of-Experts layer with expert parallelism over the `ep` mesh axis.
+
+The reference has NO MoE / expert parallelism (SURVEY.md §2.4 EP row:
+absent) — new first-class capability, built the TPU way: top-k gating with
+capacity-bounded one-hot dispatch einsums (static shapes — no ragged
+gather), experts sharded on the `ep` axis; under jit the dispatch/combine
+einsums against ep-sharded expert weights lower to XLA all-to-alls on ICI.
+
+Math follows the public Switch/GShard formulation: router softmax → top-k
+experts per token → capacity-truncated dispatch mask → expert MLPs →
+gate-weighted combine, plus the standard load-balancing auxiliary loss.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.layers import gelu
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_hidden: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coeff: float = 0.01
+    dtype: Any = jnp.bfloat16
+
+
+def moe_init(key: jax.Array, cfg: MoEConfig) -> dict:
+    kr, k1, k2 = jax.random.split(key, 3)
+    E, D, H = cfg.num_experts, cfg.d_model, cfg.d_hidden
+    return {
+        "router": jax.random.normal(kr, (D, E), jnp.float32) * 0.02,
+        "w_in": jax.random.normal(k1, (E, D, H), jnp.float32) * (D**-0.5),
+        "w_out": jax.random.normal(k2, (E, H, D), jnp.float32) * (H**-0.5),
+    }
+
+
+def moe_logical_axes() -> dict:
+    """Logical axis names per param (for ray_tpu.parallel.sharding rules:
+    'expert' maps to the ep mesh axis)."""
+    return {
+        "router": (None, None),
+        "w_in": ("expert", None, "mlp"),
+        "w_out": ("expert", "mlp", None),
+    }
+
+
+def moe_forward(params: dict, x: jax.Array, cfg: MoEConfig):
+    """x: [tokens, d_model] -> (y, aux_loss).
+
+    Dispatch/combine are dense one-hot einsums over a capacity-bounded
+    buffer [E, C, D]; with w_in/w_out sharded on the expert axis XLA turns
+    the [E, C, D] intermediates into all-to-alls across ep.
+    """
+    T, D = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    capacity = max(1, int(cfg.capacity_factor * k * T / E))
+
+    router_logits = (x.astype(jnp.float32) @ params["router"])  # [T, E] f32
+    probs = jax.nn.softmax(router_logits, axis=-1)
+
+    # top-k expert choice per token
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [T, k]
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    # position of each (token, choice) within its expert's capacity buffer
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [T, k, E]
+    flat = onehot.reshape(T * k, E)
+    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(T, k, E)
+    pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [T, k]
+    keep = pos < capacity  # overflow tokens drop (standard Switch behavior)
+    gate_vals = gate_vals * keep.astype(gate_vals.dtype)
+
+    # dispatch tensor [T, k, E, C] — one-hot over (expert, slot)
+    slot_onehot = jax.nn.one_hot(pos, capacity, dtype=cfg.dtype)  # [T, k, C]
+    dispatch = (
+        onehot.astype(cfg.dtype)[..., None] * slot_onehot[..., None, :]
+    ) * keep.astype(cfg.dtype)[..., None, None]  # [T, k, E, C]
+    combine = dispatch * gate_vals.astype(cfg.dtype)[..., None, None]
+
+    xb = x.astype(cfg.dtype)
+    expert_in = jnp.einsum("td,tkec->ecd", xb, dispatch)  # [E, C, D]
+    h = gelu(jnp.einsum("ecd,edh->ech", expert_in, params["w_in"].astype(cfg.dtype)))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, params["w_out"].astype(cfg.dtype))
+    y = jnp.einsum("ecd,tkec->td", expert_out, combine).astype(x.dtype)
+
+    # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e
+    me = jnp.mean(probs, axis=0)  # mean router prob per expert
+    # fraction of tokens whose top-1 choice is each expert
+    ce = jnp.sum(
+        jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0
+    ) / T
+    aux = cfg.aux_loss_coeff * E * jnp.sum(me * ce)
+    return y, aux
+
+
+def moe_reference_dense(params: dict, x: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Every token through every chosen expert WITHOUT capacity limits —
+    correctness oracle for tests (top-k gating, no drops)."""
+    T, D = x.shape
+    probs = jax.nn.softmax(x.astype(jnp.float32) @ params["router"], axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    xb = x.astype(cfg.dtype)
+    # [E, T, D]: run all tokens through all experts, then select
+    h = gelu(jnp.einsum("td,edh->eth", xb, params["w_in"].astype(cfg.dtype)))
+    all_out = jnp.einsum("eth,ehd->etd", h, params["w_out"].astype(cfg.dtype))
+    out = jnp.zeros_like(xb)
+    for j in range(cfg.top_k):
+        sel = jnp.take_along_axis(
+            all_out, expert_idx[None, :, j, None], axis=0
+        )[0]  # [T, D]
+        out = out + sel * gate_vals[:, j, None].astype(cfg.dtype)
+    return out.astype(x.dtype)

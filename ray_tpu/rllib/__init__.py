@@ -1,0 +1,44 @@
+"""ray_tpu.rllib — reinforcement learning on the distributed core.
+
+Equivalent of the reference's RLlib (reference: rllib/ — SURVEY.md §2.3 A6,
+§3.5 call stack). TPU mapping: EnvRunners are CPU actors running a numpy
+policy path; the Learner is a jitted train step on the device (mesh-aware
+data parallelism via sharded batches); weights sync device→host once per
+iteration.
+"""
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms import DQN, DQNConfig, PPO, PPOConfig
+from ray_tpu.rllib.env import (
+    CartPole,
+    Corridor,
+    Env,
+    GymEnv,
+    VectorEnv,
+    make_env,
+    register_env,
+)
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import ActorCriticModule, QModule
+
+__all__ = [
+    "ActorCriticModule",
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPole",
+    "Corridor",
+    "DQN",
+    "DQNConfig",
+    "Env",
+    "EnvRunner",
+    "GymEnv",
+    "Learner",
+    "PPO",
+    "PPOConfig",
+    "QModule",
+    "ReplayBuffer",
+    "VectorEnv",
+    "make_env",
+    "register_env",
+]

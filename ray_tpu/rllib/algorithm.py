@@ -1,0 +1,248 @@
+"""AlgorithmConfig + Algorithm base — the RL training driver.
+
+Equivalent of the reference's Algorithm(Trainable) and AlgorithmConfig
+(reference: rllib/algorithms/algorithm.py:191, step() :815,
+training_step() :1402; algorithm_config.py:118 fluent builder). The driver
+loop: fan rollout collection out to EnvRunner actors (or a local runner),
+aggregate batches, run jitted learner updates, broadcast weights back —
+SURVEY.md §3.5's TPU mapping.
+"""
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any
+
+import numpy as np
+
+
+class AlgorithmConfig:
+    """Fluent config builder (reference: algorithm_config.py:118)."""
+
+    def __init__(self):
+        self.env_spec: Any = None
+        self.num_env_runners = 0  # 0 = sample in the driver process
+        self.num_envs_per_runner = 4
+        self.rollout_length = 64
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 256
+        self.minibatch_size = 128
+        self.num_epochs = 4
+        self.hidden = (64, 64)
+        self.max_grad_norm = 0.5
+        self.seed = 0
+        self.mesh = None  # optional jax Mesh with a 'data' axis for the learner
+        self.extra: dict = {}
+
+    # -- builder surface (mirrors the reference's groups) --
+
+    def environment(self, env: Any) -> "AlgorithmConfig":
+        self.env_spec = env
+        return self
+
+    def env_runners(
+        self,
+        num_env_runners: int | None = None,
+        num_envs_per_runner: int | None = None,
+        rollout_length: int | None = None,
+    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_runner is not None:
+            self.num_envs_per_runner = num_envs_per_runner
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def learners(self, mesh=None) -> "AlgorithmConfig":
+        self.mesh = mesh
+        return self
+
+    def debugging(self, seed: int | None = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)  # set by subclass
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in self.__dict__.items() if k not in ("mesh",)}
+        return d
+
+
+class Algorithm:
+    """Base driver. Subclasses define `_make_runner_factory` and
+    `training_step`."""
+
+    runner_mode = "actor_critic"
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._runners = []  # actor handles, or [local EnvRunner]
+        self._local_runner = None
+        self._recent_returns: list[float] = []
+        self._total_env_steps = 0
+        self._setup()
+
+    # -- setup --
+
+    def _setup(self) -> None:
+        cfg = self.config
+        factory = self._runner_factory()
+        if cfg.num_env_runners > 0:
+            import ray_tpu
+            from ray_tpu.rllib.env_runner import EnvRunner
+
+            runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
+            self._runners = [
+                runner_cls.remote(
+                    cfg.env_spec,
+                    factory,
+                    num_envs=cfg.num_envs_per_runner,
+                    rollout_length=cfg.rollout_length,
+                    seed=cfg.seed + 1 + i,
+                    mode=self.runner_mode,
+                )
+                for i in range(cfg.num_env_runners)
+            ]
+            import ray_tpu as rt
+
+            info = rt.get(self._runners[0].env_info.remote(), timeout=120)
+        else:
+            from ray_tpu.rllib.env_runner import EnvRunner
+
+            self._local_runner = EnvRunner(
+                cfg.env_spec,
+                factory,
+                num_envs=cfg.num_envs_per_runner,
+                rollout_length=cfg.rollout_length,
+                seed=cfg.seed,
+                mode=self.runner_mode,
+            )
+            info = self._local_runner.env_info()
+        self.obs_dim = info["observation_dim"]
+        self.num_actions = info["num_actions"]
+        self._build_learner()
+
+    def _runner_factory(self):
+        """Callable (obs_dim, num_actions) -> module, cloudpickled to
+        runner actors."""
+        raise NotImplementedError
+
+    def _build_learner(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    # -- rollout plumbing --
+
+    def _broadcast_weights(self, params_np: dict, epsilon: float | None = None) -> None:
+        if self._local_runner is not None:
+            self._local_runner.set_weights(params_np, epsilon)
+        else:
+            import ray_tpu
+
+            ray_tpu.get(
+                [r.set_weights.remote(params_np, epsilon) for r in self._runners],
+                timeout=120,
+            )
+
+    def _sample_all(self) -> list[dict]:
+        """synchronous_parallel_sample (reference: rollout_ops.py:21)."""
+        if self._local_runner is not None:
+            batches = [self._local_runner.sample()]
+        else:
+            import ray_tpu
+
+            batches = ray_tpu.get(
+                [r.sample.remote() for r in self._runners], timeout=300
+            )
+        for b in batches:
+            self._recent_returns.extend(b["episode_returns"].tolist())
+            self._total_env_steps += b["rewards"].size
+        self._recent_returns = self._recent_returns[-100:]
+        return batches
+
+    # -- public Trainable surface --
+
+    def train(self) -> dict:
+        """One iteration (reference: Trainable.train → step → training_step)."""
+        t0 = time.monotonic()
+        metrics = self.training_step()
+        self.iteration += 1
+        metrics.update(
+            {
+                "training_iteration": self.iteration,
+                "num_env_steps_sampled_lifetime": self._total_env_steps,
+                "episode_return_mean": (
+                    float(np.mean(self._recent_returns))
+                    if self._recent_returns
+                    else float("nan")
+                ),
+                "time_this_iter_s": time.monotonic() - t0,
+            }
+        )
+        return metrics
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        self._runners = []
+
+    # -- checkpointing (Trainable save/restore surface) --
+
+    def save_state(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+            "learner": self.learner.state(),
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self.learner.load_state(state["learner"])
+
+    @classmethod
+    def as_trainable(cls, base_config: AlgorithmConfig, stop_iters: int = 10):
+        """Adapter to the tune function-trainable API: hyperparams from the
+        tune config dict overlay the base config (reference: Algorithm IS a
+        Trainable class; our tune runs function trainables)."""
+
+        def trainable(tune_config: dict):
+            from ray_tpu import tune as rt_tune
+
+            cfg = base_config.copy()
+            for k, v in tune_config.items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+                else:
+                    cfg.extra[k] = v
+            algo = cls(cfg)
+            try:
+                for _ in range(stop_iters):
+                    rt_tune.report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+__all__ = ["DQN", "DQNConfig", "PPO", "PPOConfig"]

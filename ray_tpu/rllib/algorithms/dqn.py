@@ -1,0 +1,107 @@
+"""DQN — Q-learning with replay buffer and target network.
+
+Equivalent of the reference's DQN
+(reference: rllib/algorithms/dqn/dqn.py training_step — sample, store to
+replay, update from replay, periodic target sync; loss in
+dqn/torch/dqn_torch_learner, double-Q per Hasselt). Double-DQN targets by
+default; epsilon-greedy exploration annealed per env step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import QModule
+
+
+def dqn_loss(module, params, batch, config):
+    """Double-DQN TD loss (pure jax). target_params ride inside the batch
+    so the jitted signature stays (params, opt_state, batch)."""
+    import jax
+    import jax.numpy as jnp
+
+    q = module.forward(params, batch["obs"])
+    q_taken = jnp.take_along_axis(q, batch["actions"][:, None], axis=-1)[:, 0]
+    q_next_online = module.forward(params, batch["next_obs"])
+    q_next_target = module.forward(batch["target_params"], batch["next_obs"])
+    best = jnp.argmax(q_next_online, axis=-1)
+    q_next = jnp.take_along_axis(q_next_target, best[:, None], axis=-1)[:, 0]
+    not_term = 1.0 - batch["terminateds"].astype(q.dtype)
+    target = batch["rewards"] + config["gamma"] * not_term * q_next
+    td = q_taken - jax.lax.stop_gradient(target)
+    loss = jnp.mean(jnp.square(td))
+    return loss, {"q_mean": jnp.mean(q_taken), "td_abs": jnp.mean(jnp.abs(td))}
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.target_update_freq = 200  # in gradient steps
+        self.updates_per_iteration = 32
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 5_000
+        self.lr = 1e-3
+        self.algo_class = DQN
+
+
+class DQN(Algorithm):
+    runner_mode = "epsilon_greedy"
+
+    def _runner_factory(self):
+        hidden = tuple(self.config.hidden)
+        return lambda obs_dim, n_act: QModule(obs_dim, n_act, hidden)
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        module = QModule(self.obs_dim, self.num_actions, cfg.hidden)
+        self.learner = Learner(
+            module,
+            dqn_loss,
+            config={"gamma": cfg.gamma},
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim, seed=cfg.seed)
+        self._target_params = self.learner.get_weights_np()
+        self._grad_steps = 0
+        self._broadcast_weights(self.learner.get_weights_np(), self._epsilon())
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._total_env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        for b in self._sample_all():
+            T, E = b["rewards"].shape
+            self.buffer.add_batch(
+                b["obs"].reshape(T * E, -1),
+                b["actions"].reshape(-1),
+                b["rewards"].reshape(-1),
+                b["next_obs"].reshape(T * E, -1),
+                b["terminateds"].reshape(-1),
+            )
+        metrics_acc: dict[str, list[float]] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                mb = self.buffer.sample(cfg.minibatch_size)
+                mb["target_params"] = self._target_params
+                m = self.learner.update(mb)
+                self._grad_steps += 1
+                if self._grad_steps % cfg.target_update_freq == 0:
+                    self._target_params = self.learner.get_weights_np()
+                for k, v in m.items():
+                    metrics_acc.setdefault(k, []).append(v)
+        self._broadcast_weights(self.learner.get_weights_np(), self._epsilon())
+        out = {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+        out["epsilon"] = self._epsilon()
+        out["replay_size"] = len(self.buffer)
+        return out

@@ -1,0 +1,148 @@
+"""PPO — clipped-surrogate policy optimization with GAE.
+
+Equivalent of the reference's PPO new-stack implementation
+(reference: rllib/algorithms/ppo/ppo.py:420 training_step —
+sample → learner update → weight broadcast; loss in
+rllib/algorithms/ppo/torch/ppo_torch_learner.py). The loss is a pure jax
+function jitted inside the Learner; minibatch epochs run as repeated jit
+calls on fixed shapes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.rl_module import ActorCriticModule
+
+
+def compute_gae(batch: dict, gamma: float, lam: float):
+    """Generalized advantage estimation over a [T, E] rollout (host-side
+    numpy — sequential scan over T is cheap and stays off the device)."""
+    rewards, values = batch["rewards"], batch["values"]
+    terms, dones = batch["terminateds"], batch["dones"]
+    boot = batch.get("bootstrap_values")
+    T, E = rewards.shape
+    adv = np.zeros((T, E), np.float32)
+    last_adv = np.zeros(E, np.float32)
+    next_values = batch["last_values"]
+    for t in range(T - 1, -1, -1):
+        # truncated (done but not terminated) episodes still bootstrap — from
+        # V(true final obs) recorded at the boundary, not the auto-reset obs
+        not_term = 1.0 - terms[t].astype(np.float32)
+        not_done = 1.0 - dones[t].astype(np.float32)
+        nv = next_values
+        if boot is not None:
+            nv = np.where(dones[t], boot[t], next_values)
+        delta = rewards[t] + gamma * nv * not_term - values[t]
+        last_adv = delta + gamma * lam * not_done * last_adv
+        adv[t] = last_adv
+        next_values = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+def ppo_loss(module, params, batch, config):
+    """Clipped surrogate + value loss + entropy bonus (pure jax)."""
+    import jax.numpy as jnp
+
+    logits, values = module.forward(params, batch["obs"])
+    logp_all = _log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+    ratio = jnp.exp(logp - batch["logp_old"])
+    clip = config["clip_param"]
+    adv = batch["advantages"]
+    surrogate = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv
+    )
+    policy_loss = -jnp.mean(surrogate)
+    value_loss = jnp.mean(jnp.square(values - batch["value_targets"]))
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = (
+        policy_loss
+        + config["vf_loss_coeff"] * value_loss
+        - config["entropy_coeff"] * entropy
+    )
+    metrics = {
+        "policy_loss": policy_loss,
+        "vf_loss": value_loss,
+        "entropy": entropy,
+        "mean_kl": jnp.mean(batch["logp_old"] - logp),
+    }
+    return total, metrics
+
+
+def _log_softmax(logits):
+    import jax.numpy as jnp
+
+    z = logits - jnp.max(logits, axis=-1, keepdims=True)
+    return z - jnp.log(jnp.sum(jnp.exp(z), axis=-1, keepdims=True))
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.gae_lambda = 0.95
+        self.algo_class = PPO
+
+
+class PPO(Algorithm):
+    runner_mode = "actor_critic"
+
+    def _runner_factory(self):
+        hidden = tuple(self.config.hidden)
+        return lambda obs_dim, n_act: ActorCriticModule(obs_dim, n_act, hidden)
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        module = ActorCriticModule(self.obs_dim, self.num_actions, cfg.hidden)
+        self.learner = Learner(
+            module,
+            ppo_loss,
+            config={
+                "clip_param": cfg.clip_param,
+                "vf_loss_coeff": cfg.vf_loss_coeff,
+                "entropy_coeff": cfg.entropy_coeff,
+            },
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self._rng = np.random.default_rng(cfg.seed + 7)
+        self._broadcast_weights(self.learner.get_weights_np())
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        batches = self._sample_all()
+        # flatten [T, E] rollouts into one training batch
+        flat = {"obs": [], "actions": [], "logp_old": [], "advantages": [],
+                "value_targets": []}
+        for b in batches:
+            adv, ret = compute_gae(b, cfg.gamma, cfg.gae_lambda)
+            T, E = b["rewards"].shape
+            flat["obs"].append(b["obs"].reshape(T * E, -1))
+            flat["actions"].append(b["actions"].reshape(-1))
+            flat["logp_old"].append(b["logp"].reshape(-1))
+            flat["advantages"].append(adv.reshape(-1))
+            flat["value_targets"].append(ret.reshape(-1))
+        train = {k: np.concatenate(v) for k, v in flat.items()}
+        adv = train["advantages"]
+        train["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        n = len(train["actions"])
+        mb = min(cfg.minibatch_size, n)
+        metrics_acc: dict[str, list[float]] = {}
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start : start + mb]  # fixed mb => stable jit shapes
+                minibatch = {k: v[idx] for k, v in train.items()}
+                m = self.learner.update(minibatch)
+                for k, v in m.items():
+                    metrics_acc.setdefault(k, []).append(v)
+        self._broadcast_weights(self.learner.get_weights_np())
+        return {k: float(np.mean(v)) for k, v in metrics_acc.items()}

@@ -1,0 +1,216 @@
+"""RL environment layer: Env protocol, vectorization, built-in envs.
+
+Equivalent of the reference's env layer (reference: rllib/env/env_runner.py:9
+EnvRunner protocol, rllib/env/ vector/external envs; gymnasium is the
+reference's env API). Envs here are plain-Python with numpy observations —
+env stepping stays on CPU actors by design (SURVEY.md §3.5: "EnvRunners stay
+CPU actors"); only the learner touches the device mesh.
+
+A gymnasium env can be wrapped with GymEnv when the package is available,
+but the built-ins avoid the dependency entirely.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Env:
+    """Single-agent episodic env protocol (gymnasium-shaped).
+
+    reset(seed) -> obs ; step(action) -> (obs, reward, terminated, truncated).
+    """
+
+    observation_dim: int
+    num_actions: int
+    max_episode_steps: int = 1000
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int):
+        raise NotImplementedError
+
+
+class CartPole(Env):
+    """Classic cart-pole balancing (standard physics; reference uses
+    gymnasium's CartPole-v1 throughout its tuned examples)."""
+
+    observation_dim = 4
+    num_actions = 2
+    max_episode_steps = 500
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * np.pi / 180
+
+    def __init__(self):
+        self._rng = np.random.default_rng(0)
+        self._state = np.zeros(4, np.float32)
+        self._steps = 0
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4).astype(np.float32)
+        self._steps = 0
+        return self._state.copy()
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE if action == 1 else -self.FORCE
+        cos_t, sin_t = np.cos(theta), np.sin(theta)
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pole_ml = self.POLE_MASS * self.POLE_HALF_LEN
+        temp = (force + pole_ml * theta_dot**2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_LEN * (4.0 / 3.0 - self.POLE_MASS * cos_t**2 / total_mass)
+        )
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+        x = x + self.DT * x_dot
+        x_dot = x_dot + self.DT * x_acc
+        theta = theta + self.DT * theta_dot
+        theta_dot = theta_dot + self.DT * theta_acc
+        self._state = np.array([x, x_dot, theta, theta_dot], np.float32)
+        self._steps += 1
+        terminated = bool(
+            abs(x) > self.X_LIMIT or abs(theta) > self.THETA_LIMIT
+        )
+        truncated = self._steps >= self.max_episode_steps
+        return self._state.copy(), 1.0, terminated, truncated
+
+
+class Corridor(Env):
+    """Deterministic N-cell corridor: start left, +1 at the right end,
+    small step penalty (the reference's SimpleCorridor custom-env example)."""
+
+    num_actions = 2  # 0 = left, 1 = right
+    observation_dim = 1
+
+    def __init__(self, length: int = 5):
+        self.length = length
+        self.max_episode_steps = 4 * length
+        self._pos = 0
+        self._steps = 0
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        self._pos = 0
+        self._steps = 0
+        return np.array([self._pos], np.float32)
+
+    def step(self, action: int):
+        self._pos = max(0, self._pos + (1 if action == 1 else -1))
+        self._steps += 1
+        done = self._pos >= self.length - 1
+        reward = 1.0 if done else -0.05
+        truncated = self._steps >= self.max_episode_steps
+        return np.array([self._pos], np.float32), reward, done, truncated
+
+
+class GymEnv(Env):
+    """Adapter for a gymnasium env (discrete action space)."""
+
+    def __init__(self, env_id: str, **kwargs):
+        import gymnasium as gym
+
+        self._env = gym.make(env_id, **kwargs)
+        self.observation_dim = int(np.prod(self._env.observation_space.shape))
+        self.num_actions = int(self._env.action_space.n)
+        self.max_episode_steps = getattr(
+            self._env.spec, "max_episode_steps", None
+        ) or 1000
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        obs, _ = self._env.reset(seed=seed)
+        return np.asarray(obs, np.float32).reshape(-1)
+
+    def step(self, action: int):
+        obs, reward, terminated, truncated, _ = self._env.step(int(action))
+        return (
+            np.asarray(obs, np.float32).reshape(-1),
+            float(reward),
+            bool(terminated),
+            bool(truncated),
+        )
+
+
+_REGISTRY: dict[str, type] = {"CartPole-v1": CartPole, "Corridor": Corridor}
+
+
+def register_env(name: str, creator) -> None:
+    """Register a custom env constructor (reference: ray.tune.register_env)."""
+    _REGISTRY[name] = creator
+
+
+def make_env(spec) -> Env:
+    """spec: registered name, Env subclass, or zero-arg callable."""
+    if isinstance(spec, str):
+        if spec in _REGISTRY:
+            return _REGISTRY[spec]()
+        return GymEnv(spec)
+    if isinstance(spec, type) and issubclass(spec, Env):
+        return spec()
+    if callable(spec):
+        return spec()
+    raise TypeError(f"cannot build env from {spec!r}")
+
+
+class VectorEnv:
+    """Synchronous vector of N env copies with auto-reset on episode end."""
+
+    def __init__(self, spec, num_envs: int, base_seed: int = 0):
+        self.envs = [make_env(spec) for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.observation_dim = self.envs[0].observation_dim
+        self.num_actions = self.envs[0].num_actions
+        self._episode_return = np.zeros(num_envs, np.float64)
+        self._episode_len = np.zeros(num_envs, np.int64)
+        self.completed_returns: list[float] = []
+        self.completed_lengths: list[int] = []
+        self._obs = np.stack(
+            [e.reset(seed=base_seed + i) for i, e in enumerate(self.envs)]
+        )
+
+    @property
+    def obs(self) -> np.ndarray:
+        return self._obs
+
+    def step(self, actions: np.ndarray):
+        """Returns (true_next_obs, rewards, dones[terminated|truncated],
+        terminateds). Finished envs auto-reset internally — `self.obs` then
+        holds the RESET obs for the next action selection, while the
+        returned array holds the TRUE final obs, so TD/GAE targets at
+        truncation boundaries bootstrap from the real successor state."""
+        true_next, cur_obs, rewards, dones, terms = [], [], [], [], []
+        for i, (env, a) in enumerate(zip(self.envs, actions)):
+            obs, r, terminated, truncated = env.step(int(a))
+            self._episode_return[i] += r
+            self._episode_len[i] += 1
+            done = terminated or truncated
+            true_next.append(obs)
+            if done:
+                self.completed_returns.append(float(self._episode_return[i]))
+                self.completed_lengths.append(int(self._episode_len[i]))
+                self._episode_return[i] = 0.0
+                self._episode_len[i] = 0
+                obs = env.reset()
+            cur_obs.append(obs)
+            rewards.append(r)
+            dones.append(done)
+            terms.append(terminated)
+        self._obs = np.stack(cur_obs)
+        return (
+            np.stack(true_next),
+            np.asarray(rewards, np.float32),
+            np.asarray(dones, np.bool_),
+            np.asarray(terms, np.bool_),
+        )
+
+    def pop_episode_stats(self) -> tuple[list[float], list[int]]:
+        r, l = self.completed_returns, self.completed_lengths
+        self.completed_returns, self.completed_lengths = [], []
+        return r, l

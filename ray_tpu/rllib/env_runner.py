@@ -1,0 +1,104 @@
+"""EnvRunner — rollout collection, local or as a CPU actor.
+
+Equivalent of the reference's EnvRunner/RolloutWorker
+(reference: rllib/env/env_runner.py:9, rllib/evaluation/rollout_worker.py:159;
+fan-out via rollout_ops.py:21 synchronous_parallel_sample). Runs the numpy
+policy path only — no jax in rollout processes (SURVEY.md §3.5: env stepping
+stays on CPU actors; the learner owns the device).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class EnvRunner:
+    """Steps a VectorEnv with the current policy; returns fixed-shape
+    rollout batches [T, E, ...] (static shapes keep the learner jit-stable).
+    """
+
+    def __init__(
+        self,
+        env_spec,
+        module_factory,
+        num_envs: int = 1,
+        rollout_length: int = 64,
+        seed: int = 0,
+        mode: str = "actor_critic",  # actor_critic | epsilon_greedy
+    ):
+        from ray_tpu.rllib.env import VectorEnv
+
+        self.vec = VectorEnv(env_spec, num_envs, base_seed=seed)
+        self.module = module_factory(self.vec.observation_dim, self.vec.num_actions)
+        self.rollout_length = rollout_length
+        self.mode = mode
+        self._rng = np.random.default_rng(seed + 1000)
+        self._params: dict | None = None
+        self.epsilon = 1.0
+
+    def set_weights(self, params: dict, epsilon: float | None = None) -> None:
+        self._params = params
+        if epsilon is not None:
+            self.epsilon = epsilon
+
+    def env_info(self) -> dict:
+        return {
+            "observation_dim": self.vec.observation_dim,
+            "num_actions": self.vec.num_actions,
+        }
+
+    def sample(self) -> dict:
+        """One rollout of T steps across E envs."""
+        if self._params is None:
+            raise RuntimeError("set_weights must be called before sample()")
+        T, E = self.rollout_length, self.vec.num_envs
+        obs_dim = self.vec.observation_dim
+        batch = {
+            "obs": np.empty((T, E, obs_dim), np.float32),
+            "actions": np.empty((T, E), np.int32),
+            "rewards": np.empty((T, E), np.float32),
+            "dones": np.empty((T, E), np.bool_),
+            "terminateds": np.empty((T, E), np.bool_),
+        }
+        if self.mode == "actor_critic":
+            batch["logp"] = np.empty((T, E), np.float32)
+            batch["values"] = np.empty((T, E), np.float32)
+            # V(true next obs) at episode boundaries (zeros elsewhere):
+            # truncated episodes bootstrap from the REAL successor state,
+            # not the auto-reset obs
+            batch["bootstrap_values"] = np.zeros((T, E), np.float32)
+        else:
+            batch["next_obs"] = np.empty((T, E, obs_dim), np.float32)
+        for t in range(T):
+            obs = self.vec.obs
+            batch["obs"][t] = obs
+            if self.mode == "actor_critic":
+                actions, logp, values = self.module.sample_actions_np(
+                    self._params, obs, self._rng
+                )
+                batch["logp"][t] = logp
+                batch["values"][t] = values
+            else:
+                q = self.module.forward_np(self._params, obs)
+                greedy = np.argmax(q, axis=-1)
+                random_a = self._rng.integers(0, self.vec.num_actions, size=E)
+                explore = self._rng.uniform(size=E) < self.epsilon
+                actions = np.where(explore, random_a, greedy).astype(np.int32)
+            true_next_obs, rewards, dones, terms = self.vec.step(actions)
+            batch["actions"][t] = actions
+            batch["rewards"][t] = rewards
+            batch["dones"][t] = dones
+            batch["terminateds"][t] = terms
+            if self.mode == "actor_critic":
+                if dones.any():
+                    _, v_true = self.module.forward_np(self._params, true_next_obs)
+                    batch["bootstrap_values"][t] = np.where(dones, v_true, 0.0)
+            else:
+                batch["next_obs"][t] = true_next_obs
+        if self.mode == "actor_critic":
+            # bootstrap values for the obs after the last step
+            _, last_values = self.module.forward_np(self._params, self.vec.obs)
+            batch["last_values"] = last_values.astype(np.float32)
+        returns, lengths = self.vec.pop_episode_stats()
+        batch["episode_returns"] = np.asarray(returns, np.float32)
+        batch["episode_lengths"] = np.asarray(lengths, np.int64)
+        return batch

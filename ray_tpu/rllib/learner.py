@@ -1,0 +1,106 @@
+"""Learner — the jitted gradient step, optionally over a device mesh.
+
+Equivalent of the reference's Learner/TorchLearner
+(reference: rllib/core/learner/learner.py:229; torch_learner.py:53 with DDP
+wrap at :368). TPU mapping per SURVEY.md §3.5: the Learner IS a jitted train
+step; data parallelism is a sharded batch under jit on a mesh 'data' axis
+(XLA inserts the gradient psum — no DDP wrapper object).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+
+class Learner:
+    """Owns params + optimizer state on device and applies jitted updates.
+
+    loss_fn(module, params, batch, config) -> (scalar loss, metrics dict) —
+    pure, jax-traceable; each algorithm supplies its own.
+    """
+
+    def __init__(
+        self,
+        module,
+        loss_fn: Callable,
+        config: dict,
+        learning_rate: float = 3e-4,
+        max_grad_norm: float | None = 0.5,
+        mesh=None,
+        seed: int = 0,
+    ):
+        import jax
+        import optax
+
+        self.module = module
+        self.loss_fn = loss_fn
+        self.config = dict(config)
+        self.mesh = mesh
+        chain = []
+        if max_grad_norm is not None:
+            chain.append(optax.clip_by_global_norm(max_grad_norm))
+        chain.append(optax.adam(learning_rate))
+        self._tx = optax.chain(*chain)
+        self.params = jax.tree_util.tree_map(
+            lambda x: jax.numpy.asarray(x), module.init(seed)
+        )
+        self.opt_state = self._tx.init(self.params)
+        self._update_jit = jax.jit(self._update_impl)
+        self._batch_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.parallel.mesh import AxisNames
+
+            # batch sharded over the data axis; params replicated — XLA
+            # derives the grad all-reduce (idiomatic dp, no DDP object)
+            self._batch_sharding = NamedSharding(mesh, P(AxisNames.DATA))
+            replicated = NamedSharding(mesh, P())
+            self.params = jax.device_put(self.params, replicated)
+            self.opt_state = jax.device_put(self.opt_state, replicated)
+
+    def _update_impl(self, params, opt_state, batch):
+        import jax
+        import optax
+
+        (loss, metrics), grads = jax.value_and_grad(
+            lambda p: self.loss_fn(self.module, p, batch, self.config),
+            has_aux=True,
+        )(params)
+        updates, opt_state = self._tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, metrics
+
+    def update(self, batch: dict) -> dict:
+        """One gradient step on a host batch (dict of arrays, leading dim =
+        batch). Returns float metrics."""
+        import jax
+
+        if self._batch_sharding is not None:
+            batch = jax.device_put(batch, self._batch_sharding)
+        self.params, self.opt_state, metrics = self._update_jit(
+            self.params, self.opt_state, batch
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights_np(self) -> dict:
+        """Host numpy copy for EnvRunner broadcast (device→host once per
+        iteration — SURVEY.md §3.5 'weight sync = device→host once per iter')."""
+        import jax
+
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), self.params)
+
+    def set_weights(self, params: Any) -> None:
+        import jax
+
+        self.params = jax.tree_util.tree_map(lambda x: jax.numpy.asarray(x), params)
+
+    def state(self) -> dict:
+        return {"params": self.get_weights_np()}
+
+    def load_state(self, state: dict) -> None:
+        self.set_weights(state["params"])

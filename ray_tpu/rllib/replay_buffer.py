@@ -1,0 +1,47 @@
+"""Uniform ring replay buffer for off-policy algorithms.
+
+Equivalent of the reference's replay buffers
+(reference: rllib/utils/replay_buffers/replay_buffer.py uniform storage;
+prioritized variant not yet ported). Stores flat transition arrays; samples
+fixed-size minibatches (static shapes for the jitted learner).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+        self.capacity = capacity
+        self._rng = np.random.default_rng(seed)
+        self._obs = np.empty((capacity, obs_dim), np.float32)
+        self._actions = np.empty(capacity, np.int32)
+        self._rewards = np.empty(capacity, np.float32)
+        self._next_obs = np.empty((capacity, obs_dim), np.float32)
+        self._terminated = np.empty(capacity, np.bool_)
+        self._size = 0
+        self._head = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, obs, actions, rewards, next_obs, terminated) -> None:
+        n = len(actions)
+        idx = (self._head + np.arange(n)) % self.capacity
+        self._obs[idx] = obs
+        self._actions[idx] = actions
+        self._rewards[idx] = rewards
+        self._next_obs[idx] = next_obs
+        self._terminated[idx] = terminated
+        self._head = int((self._head + n) % self.capacity)
+        self._size = int(min(self._size + n, self.capacity))
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {
+            "obs": self._obs[idx],
+            "actions": self._actions[idx],
+            "rewards": self._rewards[idx],
+            "next_obs": self._next_obs[idx],
+            "terminateds": self._terminated[idx],
+        }

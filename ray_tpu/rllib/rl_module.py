@@ -1,0 +1,130 @@
+"""RLModule — policy/value networks as pure param pytrees.
+
+Equivalent of the reference's RLModule (reference: rllib/core/rl_module/
+rl_module.py:229; torch/tf models rllib/models/; a jax model dir exists at
+rllib/models/jax/). Two forward paths over the SAME params:
+
+  * `forward` — jax, jitted inside the Learner's update on the device mesh.
+  * `forward_np` — numpy, used by CPU EnvRunner actors for action sampling
+    (no jax runtime in rollout workers: sampling a 2x64 MLP is
+    memory-latency-bound, and keeping jax out of the env actors keeps them
+    lightweight and off the TPU — SURVEY.md §3.5 TPU mapping).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def _init_linear(rng: np.random.Generator, n_in: int, n_out: int, scale: float):
+    # orthogonal init (standard for PPO stability)
+    a = rng.normal(size=(n_in, n_out))
+    q, r = np.linalg.qr(a) if n_in >= n_out else np.linalg.qr(a.T)
+    q = q if n_in >= n_out else q.T
+    q = q[:n_in, :n_out]
+    return {
+        "w": (scale * q).astype(np.float32),
+        "b": np.zeros(n_out, np.float32),
+    }
+
+
+class ActorCriticModule:
+    """Tanh-MLP trunk with separate policy/value heads (discrete actions)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        params: dict = {"pi": [], "vf": []}
+        for head, out_dim, out_scale in (
+            ("pi", self.num_actions, 0.01),
+            ("vf", 1, 1.0),
+        ):
+            dims = [self.obs_dim, *self.hidden]
+            layers = [
+                _init_linear(rng, dims[i], dims[i + 1], np.sqrt(2))
+                for i in range(len(dims) - 1)
+            ]
+            layers.append(_init_linear(rng, dims[-1], out_dim, out_scale))
+            params[head] = layers
+        return params
+
+    # -- numpy path (EnvRunner) --
+
+    @staticmethod
+    def _mlp_np(layers: list[dict], x: np.ndarray) -> np.ndarray:
+        for layer in layers[:-1]:
+            x = np.tanh(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    def forward_np(self, params: dict, obs: np.ndarray):
+        """(logits [B, A], values [B])."""
+        logits = self._mlp_np(params["pi"], obs)
+        values = self._mlp_np(params["vf"], obs)[:, 0]
+        return logits, values
+
+    def sample_actions_np(
+        self, params: dict, obs: np.ndarray, rng: np.random.Generator
+    ):
+        """(actions, logp, values) — categorical sampling via Gumbel trick."""
+        logits, values = self.forward_np(params, obs)
+        z = logits - logits.max(axis=-1, keepdims=True)
+        logp_all = z - np.log(np.exp(z).sum(axis=-1, keepdims=True))
+        gumbel = -np.log(-np.log(rng.uniform(1e-10, 1.0, logits.shape)))
+        actions = np.argmax(logits + gumbel, axis=-1)
+        logp = np.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
+        return actions.astype(np.int32), logp.astype(np.float32), values.astype(np.float32)
+
+    # -- jax path (Learner) --
+
+    def forward(self, params, obs):
+        """Same math in jax; called inside the jitted learner update."""
+        import jax.numpy as jnp
+
+        def mlp(layers, x):
+            for layer in layers[:-1]:
+                x = jnp.tanh(x @ layer["w"] + layer["b"])
+            last = layers[-1]
+            return x @ last["w"] + last["b"]
+
+        logits = mlp(params["pi"], obs)
+        values = mlp(params["vf"], obs)[:, 0]
+        return logits, values
+
+
+class QModule:
+    """Q-network MLP for value-based algorithms (DQN family)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        dims = [self.obs_dim, *self.hidden, self.num_actions]
+        return {
+            "q": [
+                _init_linear(rng, dims[i], dims[i + 1], np.sqrt(2))
+                for i in range(len(dims) - 1)
+            ]
+        }
+
+    def forward_np(self, params: dict, obs: np.ndarray) -> np.ndarray:
+        return ActorCriticModule._mlp_np(params["q"], obs)
+
+    def forward(self, params, obs):
+        import jax.numpy as jnp
+
+        def mlp(layers, x):
+            for layer in layers[:-1]:
+                x = jnp.tanh(x @ layer["w"] + layer["b"])
+            last = layers[-1]
+            return x @ last["w"] + last["b"]
+
+        return mlp(params["q"], obs)

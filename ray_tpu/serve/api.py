@@ -58,12 +58,24 @@ def run(
     controller = _get_or_create_controller()
     apps = target.flatten()
     specs = [a.build_spec(name) for a in apps]
-    seen = set()
+    by_name: dict[str, dict] = {}
     uniq = []
     for s in specs:
-        if s["name"] in seen:
+        prev = by_name.get(s["name"])
+        if prev is not None:
+            if (
+                prev["callable_blob"] != s["callable_blob"]
+                or prev["init_args"] != s["init_args"]
+                or prev["init_kwargs"] != s["init_kwargs"]
+                or prev["config"] != s["config"]
+            ):
+                raise ValueError(
+                    f"two deployments named {s['name']!r} with different "
+                    "bind arguments in one app — give one of them "
+                    ".options(name=...) (handles route by name)"
+                )
             continue
-        seen.add(s["name"])
+        by_name[s["name"]] = s
         uniq.append(s)
     ingress = target.deployment.name
     ray_tpu.get(
@@ -80,6 +92,7 @@ def run(
 
 def _wait_healthy(controller, app_name: str, timeout_s: float) -> None:
     deadline = time.monotonic() + timeout_s
+    st: dict = {}
     while time.monotonic() < deadline:
         st = ray_tpu.get(controller.status.remote(), timeout=60)
         app = st.get(app_name, {})

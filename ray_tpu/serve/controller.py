@@ -32,6 +32,8 @@ class _ReplicaState:
         self.ping_ref = None
         self.ping_deadline = 0.0
         self.next_ping_at = 0.0
+        self.probe_ref = None  # in-flight batch_configs readiness probe
+        self.probe_deadline = 0.0
 
 
 # consecutive replica deaths before __rt first became RUNNING that flip the
@@ -242,17 +244,30 @@ class ServeController:
                 continue
             state = (info or {}).get("state")
             if state == "ALIVE" and r.state == "STARTING":
-                try:
-                    batch_cfgs = ray_tpu.get(
-                        r.handle.batch_configs.remote(), timeout=30
-                    )
+                # non-blocking readiness probe: a slow-starting replica must
+                # not stall the reconcile loop (which also drives every other
+                # deployment's health checks)
+                if r.probe_ref is None:
+                    r.probe_ref = r.handle.batch_configs.remote()
+                    r.probe_deadline = time.monotonic() + 120.0
+                elif worker.store.status(r.probe_ref.object_id) != "missing":
+                    # present OR evicted both mean the probe ran; get()
+                    # reconstructs an evicted result from lineage
+                    try:
+                        batch_cfgs = ray_tpu.get(r.probe_ref, timeout=30)
+                        with self._lock:
+                            ds.batch_configs = batch_cfgs
+                            r.state = "RUNNING"
+                            ds.consecutive_start_failures = 0
+                        changed = True
+                    except Exception as e:  # noqa: BLE001
+                        ds.last_error = f"replica probe failed: {e}"
+                    r.probe_ref = None
+                elif time.monotonic() > getattr(r, "probe_deadline", 0):
+                    self._kill_unhealthy(ds, r, "readiness probe timed out")
                     with self._lock:
-                        ds.batch_configs = batch_cfgs
-                        r.state = "RUNNING"
-                        ds.consecutive_start_failures = 0
+                        ds.consecutive_start_failures += 1
                     changed = True
-                except Exception as e:  # noqa: BLE001
-                    ds.last_error = f"replica probe failed: {e}"
             elif state == "DEAD":
                 with self._lock:
                     if r in ds.replicas:
@@ -321,7 +336,7 @@ class ServeController:
             if r.state != "RUNNING":
                 continue
             if r.ping_ref is not None:
-                done = worker.store.contains(r.ping_ref.object_id)
+                done = worker.store.status(r.ping_ref.object_id) != "missing"
                 if done:
                     try:
                         ray_tpu.get(r.ping_ref, timeout=1)
@@ -336,7 +351,11 @@ class ServeController:
             elif now >= r.next_ping_at:
                 try:
                     r.ping_ref = r.handle.ping.remote()
-                    r.ping_deadline = now + 3 * period
+                    # Pings share the replica's one-at-a-time queue with data
+                    # calls, so the deadline must exceed worst-case request
+                    # latency (handles allow 120s) — this catches truly
+                    # wedged replicas, not slow ones.
+                    r.ping_deadline = now + max(6 * period, 150.0)
                 except Exception:  # noqa: BLE001 — dead; step 1 reaps it
                     pass
         return changed
@@ -365,11 +384,25 @@ class ServeController:
     def _start_replica(self, app_name: str, ds: _DeploymentState) -> None:
         spec = ds.spec
         opts = dict(ds.config.ray_actor_options)
+        num_cpus = opts.pop("num_cpus", 1)
+        num_tpus = opts.pop("num_tpus", 0)
+        resources = dict(opts.pop("resources", None) or {})
+        # remaining numeric keys are custom resources ({"TPU": 1} rides here
+        # per DeploymentConfig's contract) — never drop them silently
+        for k in list(opts):
+            v = opts.pop(k)
+            if isinstance(v, (int, float)):
+                resources[k] = float(v)
+            else:
+                raise ValueError(
+                    f"unsupported ray_actor_options key {k!r} for deployment "
+                    f"{spec['name']!r}"
+                )
         actor_cls = ActorClass(
             ReplicaActor,
-            num_cpus=opts.pop("num_cpus", 1),
-            num_tpus=opts.pop("num_tpus", 0),
-            resources=opts.pop("resources", None),
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources or None,
             max_restarts=0,  # the reconciler owns restarts, not the raylet
         )
         handle = actor_cls.remote(

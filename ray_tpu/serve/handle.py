@@ -34,15 +34,31 @@ class DeploymentResponse:
         self._done = False
 
     def result(self, timeout: float | None = 60.0) -> Any:
+        import concurrent.futures
+
+        from ray_tpu.exceptions import GetTimeoutError
+
         try:
             if self._future is not None:
-                return self._future.result(timeout)
-            return ray_tpu.get(self._ref, timeout=timeout)
-        finally:
-            if not self._done:
-                self._done = True
-                if self._on_done:
-                    self._on_done()
+                out = self._future.result(timeout)
+            else:
+                out = ray_tpu.get(self._ref, timeout=timeout)
+        except (GetTimeoutError, concurrent.futures.TimeoutError):
+            # the request is STILL running on the replica — keep the
+            # in-flight count until it actually finishes (the router sweep
+            # reclaims it then)
+            raise
+        except BaseException:
+            self._mark_done()
+            raise
+        self._mark_done()
+        return out
+
+    def _mark_done(self) -> None:
+        if not self._done:
+            self._done = True
+            if self._on_done:
+                self._on_done()
 
     @property
     def ref(self):
@@ -103,6 +119,7 @@ class _Router:
             metrics = {
                 (self.app_name, self.deployment_name): sum(self._inflight.values())
             }
+        self._sweep()
         table = ray_tpu.get(
             self._controller_handle().get_routing_table.remote(
                 self.router_id, {tuple(k): v for k, v in metrics.items()}
@@ -125,16 +142,27 @@ class _Router:
 
     # -- in-flight accounting --
 
-    def _sweep_locked(self) -> None:
-        """Decrement in-flight for completed calls (store-contains poll —
-        cheap local check; avoids a callback thread per request)."""
+    def _decrement(self, oid: bytes) -> None:
+        """Primary decrement path: DeploymentResponse.result() on_done."""
+        with self._lock:
+            aid = self._outstanding.pop(oid, None)
+            if aid is not None:
+                self._inflight[aid] = max(0, self._inflight.get(aid, 1) - 1)
+
+    def _sweep(self) -> None:
+        """Safety net for responses whose .result() is never called: drop
+        outstanding entries whose result landed (or was evicted). Runs at
+        most once per table refresh — NOT per dispatch (a per-dispatch sweep
+        would cost O(outstanding) store round-trips per call)."""
         worker = ray_tpu.worker.global_worker()
         from ray_tpu._private.ids import ObjectID
 
-        for oid, aid in list(self._outstanding.items()):
-            if worker.store.contains(ObjectID(oid)):
-                del self._outstanding[oid]
-                self._inflight[aid] = max(0, self._inflight.get(aid, 1) - 1)
+        with self._lock:
+            snapshot = list(self._outstanding.items())
+        for oid, aid in snapshot:
+            # status(): 'present' OR 'evicted' both mean the call finished
+            if worker.store.status(ObjectID(oid)) != "missing":
+                self._decrement(oid)
 
     def _pick_replica(self, deadline: float):
         """Power of two choices over tracked in-flight counts."""
@@ -143,7 +171,6 @@ class _Router:
             with self._lock:
                 replicas = list(self._replicas)
                 if replicas:
-                    self._sweep_locked()
                     if len(replicas) == 1:
                         return replicas[0]
                     a, b = random.sample(replicas, 2)
@@ -177,10 +204,11 @@ class _Router:
         replica = self._pick_replica(time.monotonic() + 30)
         ref = replica.rt_call.remote(method_name, args, kwargs)
         aid = replica._actor_id.binary()
+        oid = ref.object_id.binary()
         with self._lock:
             self._inflight[aid] = self._inflight.get(aid, 0) + 1
-            self._outstanding[ref.object_id.binary()] = aid
-        return DeploymentResponse(ref=ref)
+            self._outstanding[oid] = aid
+        return DeploymentResponse(ref=ref, on_done=lambda: self._decrement(oid))
 
     def _call_batched(
         self, method_name: str, bc: dict, args: tuple, kwargs: dict

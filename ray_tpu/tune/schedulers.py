@@ -167,12 +167,15 @@ class PopulationBasedTraining(TrialScheduler):
         last = self._last_perturb.get(trial.trial_id, 0)
         if t - last < self.interval:
             return CONTINUE
-        self._last_perturb[trial.trial_id] = t
 
         ranked = sorted(self._state.items(), key=lambda kv: kv[1][0])
         n = len(ranked)
         if n < 2:
+            # no peer has reported yet (e.g. its actor is still spawning):
+            # leave the boundary armed instead of consuming it, so the
+            # comparison happens as soon as a peer shows up
             return CONTINUE
+        self._last_perturb[trial.trial_id] = t
         k = max(1, int(n * self.quantile))
         bottom = [tid for tid, _ in ranked[:k]]
         top = [tid for tid, _ in ranked[-k:]]

@@ -73,6 +73,7 @@ class TuneController:
         resources_per_trial: dict | None = None,
         max_failures: int = 0,
         poll_interval: float = 0.05,
+        reports_per_step: int = 8,
     ):
         self.fn_blob = ts.dumps_function(trainable)
         self.searcher = searcher
@@ -96,6 +97,10 @@ class TuneController:
         self.max_concurrent = max_concurrent_trials
         self.max_failures = max_failures
         self.poll_interval = poll_interval
+        # fairness cap: drain at most this many reports per trial per step so
+        # a fast trial cannot flood the scheduler before its peers report
+        # (rung/quantile comparisons need interleaved streams)
+        self.reports_per_step = reports_per_step
         self.trials: list[Trial] = []
         self._actors: dict[str, object] = {}
         self._cursors: dict[str, int] = {}
@@ -196,6 +201,8 @@ class TuneController:
                 self._on_trial_error(trial, f"trial actor died: {e}")
                 continue
             reports = out["reports"]
+            drained_all = len(reports) <= self.reports_per_step
+            reports = reports[: self.reports_per_step]
             self._cursors[trial.trial_id] += len(reports)
             for rep in reports:
                 progressed = True
@@ -219,7 +226,7 @@ class TuneController:
                     break
             if trial.status != RUNNING:
                 continue
-            if out["done"]:
+            if out["done"] and drained_all:
                 progressed = True
                 self._stop_actor(trial)
                 if out["error"]:

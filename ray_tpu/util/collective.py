@@ -1,0 +1,230 @@
+"""Collective communication groups for actors/tasks (host-side).
+
+Equivalent of the reference's ray.util.collective
+(reference: python/ray/util/collective/collective.py:120-615 —
+init_collective_group / allreduce / allgather / reducescatter / broadcast /
+barrier / send / recv over NCCL (GPU) or Gloo (CPU) groups).
+
+TPU mapping (SURVEY.md §5.8): the DEVICE data plane does not live here —
+in-graph collectives are XLA's (`jax.lax.psum` et al. under pjit/shard_map
+over the ICI mesh), and hosts are bootstrapped with
+`jax.distributed.initialize`. This module is the HOST-side (Gloo-analog)
+backend: numpy collectives among actor/task processes for control-plane
+sync, rendezvous, and CPU tensor exchange — coordinated by a named
+rendezvous actor, with the shared-memory object store as the data plane.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.actor import ActorClass
+
+_GROUP_ACTOR_PREFIX = "rt_collective:"
+_POLL_S = 0.005
+
+
+class _GroupCoordinator:
+    """Named actor holding per-operation contributions. Members push their
+    chunk and poll for completion (actor methods are short and non-blocking,
+    so the one-at-a-time actor queue never deadlocks)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._ops: dict[tuple, dict[int, Any]] = {}
+        self._results: dict[tuple, list] = {}
+        self._mailbox: dict[tuple, Any] = {}
+
+    def contribute(self, op_key: tuple, rank: int, value) -> None:
+        op_key = tuple(op_key)
+        pend = self._ops.setdefault(op_key, {})
+        pend[rank] = value
+        if len(pend) == self.world_size:
+            self._results[op_key] = [pend[r] for r in range(self.world_size)]
+            del self._ops[op_key]
+
+    def result(self, op_key: tuple):
+        """(ready, values) — values ordered by rank once all arrived."""
+        op_key = tuple(op_key)
+        vals = self._results.get(op_key)
+        return (True, vals) if vals is not None else (False, None)
+
+    def ack(self, op_key: tuple, rank: int) -> None:
+        """Garbage-collect a result once every rank has read it."""
+        op_key = tuple(op_key)
+        acks = self._ops.setdefault(("ack",) + op_key, {})
+        acks[rank] = True
+        if len(acks) == self.world_size:
+            self._results.pop(op_key, None)
+            del self._ops[("ack",) + op_key]
+
+    def post(self, key: tuple, value) -> None:
+        self._mailbox[tuple(key)] = value
+
+    def take(self, key: tuple):
+        return self._mailbox.pop(tuple(key), None)
+
+
+class CollectiveGroup:
+    """One member's view of a collective group."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int, handle):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._coord = handle
+        self._seq = 0
+
+    def _next_key(self, op: str) -> tuple:
+        self._seq += 1
+        return (op, self._seq)
+
+    def _exchange(self, op: str, value, timeout: float) -> list:
+        """All ranks contribute; returns rank-ordered contributions."""
+        key = self._next_key(op)
+        ray_tpu.get(
+            self._coord.contribute.remote(key, self.rank, value), timeout=timeout
+        )
+        deadline = time.monotonic() + timeout
+        while True:
+            ready, vals = ray_tpu.get(
+                self._coord.result.remote(key), timeout=timeout
+            )
+            if ready:
+                self._coord.ack.remote(key, self.rank)
+                return vals
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {op} timed out in group {self.group_name!r} "
+                    f"(rank {self.rank}/{self.world_size})"
+                )
+            time.sleep(_POLL_S)
+
+    # -- collectives (reference API shape, collective.py:120-615) --
+
+    def barrier(self, timeout: float = 120.0) -> None:
+        self._exchange("barrier", None, timeout)
+
+    def allreduce(self, array, op: str = "sum", timeout: float = 120.0):
+        vals = self._exchange("allreduce", np.asarray(array), timeout)
+        stack = np.stack(vals)
+        if op == "sum":
+            return stack.sum(axis=0)
+        if op == "mean":
+            return stack.mean(axis=0)
+        if op == "max":
+            return stack.max(axis=0)
+        if op == "min":
+            return stack.min(axis=0)
+        raise ValueError(f"unsupported reduce op {op!r}")
+
+    def allgather(self, array, timeout: float = 120.0) -> list:
+        return [np.asarray(v) for v in self._exchange("allgather", np.asarray(array), timeout)]
+
+    def broadcast(self, array, src_rank: int = 0, timeout: float = 120.0):
+        vals = self._exchange(
+            "broadcast", np.asarray(array) if self.rank == src_rank else None, timeout
+        )
+        return np.asarray(vals[src_rank])
+
+    def reducescatter(self, array, op: str = "sum", timeout: float = 120.0):
+        """Reduce then scatter equal chunks: rank r gets chunk r."""
+        reduced = self.allreduce(array, op=op, timeout=timeout)
+        chunks = np.array_split(reduced, self.world_size)
+        return chunks[self.rank]
+
+    def send(self, array, dst_rank: int, tag: int = 0, timeout: float = 120.0) -> None:
+        key = ("p2p", self.rank, dst_rank, tag)
+        ray_tpu.get(
+            self._coord.post.remote(key, np.asarray(array)), timeout=timeout
+        )
+
+    def recv(self, src_rank: int, tag: int = 0, timeout: float = 120.0):
+        key = ("p2p", src_rank, self.rank, tag)
+        deadline = time.monotonic() + timeout
+        while True:
+            v = ray_tpu.get(self._coord.take.remote(key), timeout=timeout)
+            if v is not None:
+                return np.asarray(v)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"recv from rank {src_rank} timed out")
+            time.sleep(_POLL_S)
+
+
+_groups: dict[str, CollectiveGroup] = {}
+
+
+def init_collective_group(
+    world_size: int, rank: int, group_name: str = "default", timeout: float = 120.0
+) -> CollectiveGroup:
+    """Join (rank 0 creates) the named group; blocks until all members join
+    (reference: collective.py init_collective_group / declare_collective_group).
+    """
+    actor_name = _GROUP_ACTOR_PREFIX + group_name
+    if rank == 0:
+        coord = ActorClass(
+            _GroupCoordinator, num_cpus=0.01, name=actor_name
+        ).remote(world_size)
+    else:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                coord = ray_tpu.get_actor(actor_name)
+                break
+            except ValueError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"group {group_name!r} never created")
+                time.sleep(0.05)
+    g = CollectiveGroup(group_name, world_size, rank, coord)
+    g.barrier(timeout=timeout)
+    _groups[group_name] = g
+    return g
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    g = _groups.get(group_name)
+    if g is None:
+        raise ValueError(f"collective group {group_name!r} not initialized here")
+    return g
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Tear down the group's coordinator actor. Callable from any process
+    (the coordinator is a named actor), member or not."""
+    _groups.pop(group_name, None)
+    try:
+        ray_tpu.kill(ray_tpu.get_actor(_GROUP_ACTOR_PREFIX + group_name))
+    except Exception:  # noqa: BLE001 — already gone
+        pass
+
+
+# module-level convenience mirroring the reference's functional API
+def allreduce(array, op: str = "sum", group_name: str = "default"):
+    return get_group(group_name).allreduce(array, op=op)
+
+
+def allgather(array, group_name: str = "default"):
+    return get_group(group_name).allgather(array)
+
+
+def broadcast(array, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(array, src_rank=src_rank)
+
+
+def reducescatter(array, op: str = "sum", group_name: str = "default"):
+    return get_group(group_name).reducescatter(array, op=op)
+
+
+def barrier(group_name: str = "default"):
+    get_group(group_name).barrier()
+
+
+def send(array, dst_rank: int, group_name: str = "default", tag: int = 0):
+    get_group(group_name).send(array, dst_rank, tag=tag)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    return get_group(group_name).recv(src_rank, tag=tag)

@@ -1,0 +1,107 @@
+"""Application metrics API: Counter / Gauge / Histogram.
+
+Equivalent of the reference's ray.util.metrics
+(reference: python/ray/util/metrics.py Counter/Gauge/Histogram over the C++
+OpenCensus pipeline src/ray/stats/metric.h:103-160 exported to Prometheus).
+Here metrics register into prometheus_client (in-process registry); expose
+them with `start_metrics_server(port)` and scrape, or read programmatically
+via `collect()`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+try:
+    import prometheus_client as _prom
+    from prometheus_client import CollectorRegistry
+
+    _AVAILABLE = True
+except ImportError:  # pragma: no cover - baked into this image
+    _AVAILABLE = False
+
+_registry = None
+_registry_lock = threading.Lock()
+
+
+def _get_registry():
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = CollectorRegistry()
+        return _registry
+
+
+class _Metric:
+    def __init__(self, name: str, description: str, tag_keys: Sequence[str]):
+        if not _AVAILABLE:
+            raise RuntimeError("prometheus_client not available")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict[str, str] = {}
+
+    def set_default_tags(self, tags: dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _labels(self, tags: dict[str, str] | None):
+        merged = {**self._default_tags, **(tags or {})}
+        missing = set(self.tag_keys) - set(merged)
+        if missing:
+            raise ValueError(f"metric {self.name} missing tags: {sorted(missing)}")
+        return [merged[k] for k in self.tag_keys]
+
+
+class Counter(_Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._c = _prom.Counter(
+            name, description, labelnames=self.tag_keys, registry=_get_registry()
+        )
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        c = self._c.labels(*self._labels(tags)) if self.tag_keys else self._c
+        c.inc(value)
+
+
+class Gauge(_Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._g = _prom.Gauge(
+            name, description, labelnames=self.tag_keys, registry=_get_registry()
+        )
+
+    def set(self, value: float, tags: dict | None = None):
+        g = self._g.labels(*self._labels(tags)) if self.tag_keys else self._g
+        g.set(value)
+
+
+class Histogram(_Metric):
+    def __init__(self, name, description="", boundaries=(), tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        kwargs = {"registry": _get_registry(), "labelnames": self.tag_keys}
+        if boundaries:
+            kwargs["buckets"] = tuple(boundaries)
+        self._h = _prom.Histogram(name, description, **kwargs)
+
+    def observe(self, value: float, tags: dict | None = None):
+        h = self._h.labels(*self._labels(tags)) if self.tag_keys else self._h
+        h.observe(value)
+
+
+def start_metrics_server(port: int = 9090) -> None:
+    """Expose the registry on http://0.0.0.0:port/metrics (Prometheus
+    scrape target — the analog of the reference's per-node metrics agent)."""
+    _prom.start_http_server(port, registry=_get_registry())
+
+
+def collect() -> dict[str, float]:
+    """Programmatic snapshot: {'name{label=v}': value} for tests/inspection."""
+    out = {}
+    for family in _get_registry().collect():
+        for sample in family.samples:
+            labels = ",".join(f"{k}={v}" for k, v in sorted(sample.labels.items()))
+            key = f"{sample.name}{{{labels}}}" if labels else sample.name
+            out[key] = sample.value
+    return out

@@ -15,6 +15,17 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 import pytest
 
+# Force the CPU platform for the WHOLE test process now, before any test
+# module touches jax: backend selection is one-shot, and a test that
+# device_puts on the real TPU first would leave the session fixture with a
+# single axon device instead of the 8-device virtual mesh.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — jax missing or already initialized
+    pass
+
 
 def _force_cpu_jax():
     import jax

@@ -1,0 +1,141 @@
+"""State API, task events, timeline, metrics, collectives
+(model: reference python/ray/tests/test_state_api.py, test_metrics_agent.py,
+util/collective tests)."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_state_api_and_timeline(ray_start, tmp_path):
+    rt = ray_start
+    from ray_tpu.util import state
+
+    @rt.remote
+    def work(x):
+        time.sleep(0.05)
+        return x
+
+    @rt.remote
+    def fail():
+        raise ValueError("intentional")
+
+    rt.get([work.remote(i) for i in range(3)], timeout=120)
+    with pytest.raises(ValueError):
+        rt.get(fail.remote(), timeout=120)
+    time.sleep(1.0)  # event flush interval
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+
+    tasks = state.list_tasks()
+    names = {t["name"] for t in tasks}
+    assert "work" in names and "fail" in names
+    work_rows = [t for t in tasks if t["name"] == "work"]
+    assert len(work_rows) == 3
+    assert all(t["state"] == "FINISHED" for t in work_rows)
+    fail_rows = [t for t in tasks if t["name"] == "fail"]
+    assert fail_rows[0]["state"] == "FAILED"
+    assert work_rows[0]["finished_at"] >= work_rows[0]["started_at"]
+
+    summ = state.summarize_tasks()
+    assert summ["work"]["count"] == 3
+    assert summ["work"]["states"]["FINISHED"] == 3
+    assert summ["work"]["total_time_s"] > 0.1
+
+    # chrome trace
+    trace = state.timeline()
+    assert any(e["name"] == "work" and e["ph"] == "X" for e in trace)
+    out = tmp_path / "trace.json"
+    state.timeline(str(out))
+    assert out.exists() and out.stat().st_size > 10
+
+    top = state.summary()
+    assert top["nodes"]["alive"] == 1
+    assert top["resources"]["total"]["CPU"] == 4
+
+
+def test_actor_state_listing(ray_start):
+    rt = ray_start
+    from ray_tpu.util import state
+
+    @rt.remote
+    class A:
+        def ping(self):
+            return "ok"
+
+    a = A.remote()
+    rt.get(a.ping.remote(), timeout=120)
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+    rt.kill(a)
+
+
+def test_metrics_counter_gauge_histogram():
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("rt_test_events_total", "events", tag_keys=("kind",))
+    c.inc(tags={"kind": "a"})
+    c.inc(2.0, tags={"kind": "a"})
+    g = metrics.Gauge("rt_test_inflight", "inflight")
+    g.set(7)
+    h = metrics.Histogram(
+        "rt_test_latency_s", "latency", boundaries=(0.1, 1.0), tag_keys=()
+    )
+    h.observe(0.05)
+    h.observe(0.5)
+    snap = metrics.collect()
+    assert snap['rt_test_events_total{kind=a}'] == 3.0
+    assert snap["rt_test_inflight"] == 7.0
+    assert snap["rt_test_latency_s_count"] == 2.0
+    with pytest.raises(ValueError):
+        c.inc()  # missing tag
+
+
+def test_collective_group_among_actors(ray_start):
+    rt = ray_start
+    from ray_tpu.util import collective as col
+
+    @rt.remote
+    class Member:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def run(self):
+            import numpy as np
+
+            from ray_tpu.util import collective as col
+
+            g = col.init_collective_group(self.world, self.rank, "grp")
+            red = g.allreduce(np.full(4, self.rank + 1.0))
+            gathered = g.allgather(np.array([self.rank]))
+            bcast = g.broadcast(np.array([42.0]) if self.rank == 0 else None, 0)
+            rs = g.reducescatter(np.arange(4, dtype=np.float64))
+            if self.rank == 0:
+                g.send(np.array([99.0]), dst_rank=1)
+                p2p = None
+            else:
+                p2p = g.recv(src_rank=0)
+            g.barrier()
+            return {
+                "allreduce": red.tolist(),
+                "allgather": [int(x[0]) for x in gathered],
+                "broadcast": float(bcast[0]),
+                "reducescatter": rs.tolist(),
+                "p2p": None if p2p is None else float(p2p[0]),
+            }
+
+    world = 2
+    members = [Member.remote(r, world) for r in range(world)]
+    outs = rt.get([m.run.remote() for m in members], timeout=240)
+    for r, o in enumerate(outs):
+        assert o["allreduce"] == [3.0] * 4  # 1+2
+        assert o["allgather"] == [0, 1]
+        assert o["broadcast"] == 42.0
+    # reducescatter: reduced = [0,2,4,6]; rank0 chunk [0,2], rank1 [4,6]
+    assert outs[0]["reducescatter"] == [0.0, 2.0]
+    assert outs[1]["reducescatter"] == [4.0, 6.0]
+    assert outs[1]["p2p"] == 99.0
+    col.destroy_collective_group("grp")

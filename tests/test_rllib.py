@@ -1,0 +1,146 @@
+"""RLlib layer: envs, GAE, PPO/DQN learning, actor fan-out
+(model: reference rllib/algorithms/ppo/tests/test_ppo.py learning tests on
+CartPole; rllib/tests for rollout mechanics)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import CartPole, Corridor, VectorEnv
+from ray_tpu.rllib.algorithms.ppo import compute_gae
+
+
+def test_cartpole_env_contract():
+    env = CartPole()
+    obs = env.reset(seed=0)
+    assert obs.shape == (4,)
+    total = 0
+    for _ in range(10):
+        obs, r, term, trunc = env.step(1)
+        total += r
+        if term or trunc:
+            break
+    assert total > 0
+
+
+def test_vector_env_autoreset_and_stats():
+    vec = VectorEnv(Corridor, num_envs=3)
+    for _ in range(30):
+        vec.step(np.ones(3, np.int64))
+    returns, lengths = vec.pop_episode_stats()
+    assert len(returns) >= 3  # corridor solves in 4 right-steps
+    assert all(l >= 4 for l in lengths)
+
+
+def test_gae_simple_case():
+    # single env, 3 steps, no termination: check against hand-rolled GAE
+    batch = {
+        "rewards": np.array([[1.0], [1.0], [1.0]], np.float32),
+        "values": np.array([[0.5], [0.5], [0.5]], np.float32),
+        "terminateds": np.zeros((3, 1), np.bool_),
+        "dones": np.zeros((3, 1), np.bool_),
+        "last_values": np.array([0.5], np.float32),
+    }
+    adv, ret = compute_gae(batch, gamma=1.0, lam=1.0)
+    # with gamma=lam=1: adv[t] = sum(r) + V_T - V_t
+    assert adv[0, 0] == pytest.approx(3.0 + 0.5 - 0.5)
+    assert adv[2, 0] == pytest.approx(1.0 + 0.5 - 0.5)
+    assert ret[0, 0] == pytest.approx(adv[0, 0] + 0.5)
+
+
+def test_gae_respects_termination():
+    batch = {
+        "rewards": np.array([[1.0], [1.0]], np.float32),
+        "values": np.array([[0.0], [0.0]], np.float32),
+        "terminateds": np.array([[True], [False]], np.bool_),
+        "dones": np.array([[True], [False]], np.bool_),
+        "last_values": np.array([100.0], np.float32),
+    }
+    adv, _ = compute_gae(batch, gamma=0.9, lam=1.0)
+    # step 0 terminated: no bootstrap through it
+    assert adv[0, 0] == pytest.approx(1.0)
+
+
+def test_ppo_learns_cartpole(jax_cpu):
+    from ray_tpu.rllib import PPOConfig
+
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_runner=8, rollout_length=128)
+        .training(lr=3e-4, minibatch_size=256, num_epochs=6, entropy_coeff=0.01)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    best = 0.0
+    for _ in range(25):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if best >= 150.0:
+            break
+    assert best >= 150.0, f"PPO failed to learn CartPole: best={best}"
+
+
+def test_dqn_learns_corridor(jax_cpu):
+    from ray_tpu.rllib import DQNConfig
+
+    cfg = (
+        DQNConfig()
+        .environment("Corridor")
+        .env_runners(num_env_runners=0, num_envs_per_runner=4, rollout_length=32)
+        .training(
+            lr=1e-3,
+            minibatch_size=64,
+            learning_starts=200,
+            epsilon_decay_steps=1500,
+            updates_per_iteration=64,
+            target_update_freq=100,
+        )
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    result = {}
+    for _ in range(30):
+        result = algo.train()
+        if result["episode_return_mean"] >= 0.7:
+            break
+    # optimal corridor return = 1 - 3*0.05 = 0.85; near-optimal passes
+    assert result["episode_return_mean"] >= 0.7, result
+
+
+def test_ppo_remote_env_runners(ray_start, jax_cpu):
+    from ray_tpu.rllib import PPOConfig
+
+    cfg = (
+        PPOConfig()
+        .environment(Corridor)
+        .env_runners(num_env_runners=2, num_envs_per_runner=2, rollout_length=16)
+        .training(minibatch_size=64, num_epochs=2)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    r1 = algo.train()
+    r2 = algo.train()
+    assert r2["num_env_steps_sampled_lifetime"] == 2 * 2 * 2 * 16
+    assert np.isfinite(r1["loss"]) and np.isfinite(r2["loss"])
+    algo.stop()
+
+
+def test_algorithm_checkpoint_roundtrip(jax_cpu):
+    from ray_tpu.rllib import PPOConfig
+
+    cfg = (
+        PPOConfig()
+        .environment(Corridor)
+        .env_runners(num_envs_per_runner=2, rollout_length=16)
+        .training(minibatch_size=32, num_epochs=1)
+    )
+    algo = cfg.build()
+    algo.train()
+    state = algo.save_state()
+    algo2 = cfg.build()
+    algo2.load_state(state)
+    assert algo2.iteration == algo.iteration
+    w1 = algo.learner.get_weights_np()
+    w2 = algo2.learner.get_weights_np()
+    np.testing.assert_allclose(w1["pi"][0]["w"], w2["pi"][0]["w"], rtol=1e-6)

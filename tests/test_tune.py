@@ -215,7 +215,18 @@ def test_pbt_exploits_good_trials(ray_start):
     from ray_tpu import tune
     from ray_tpu.train import Checkpoint
 
+    sync_dir = tempfile.mkdtemp()
+
     def trainable(config):
+        # rendezvous so both population members genuinely overlap (PBT's
+        # quantile comparison needs concurrent streams; without this the
+        # fast trial can finish before its peer's actor even spawns)
+        import time as _time
+
+        open(os.path.join(config["sync_dir"], f"ready-{config['rate']}"), "w").close()
+        deadline = _time.monotonic() + 60
+        while len(os.listdir(config["sync_dir"])) < 2 and _time.monotonic() < deadline:
+            _time.sleep(0.05)
         # score accumulates by `rate` each step; checkpoint carries the total
         total = 0.0
         ckpt = tune.get_checkpoint()
@@ -228,10 +239,11 @@ def test_pbt_exploits_good_trials(ray_start):
             with open(os.path.join(d, "s.json"), "w") as f:
                 json.dump({"total": total}, f)
             tune.report({"total": total}, checkpoint=Checkpoint.from_directory(d))
+            _time.sleep(0.01)
 
     results = tune.Tuner(
         trainable,
-        param_space={"rate": tune.grid_search([0.01, 1.0])},
+        param_space={"rate": tune.grid_search([0.01, 1.0]), "sync_dir": sync_dir},
         tune_config=tune.TuneConfig(
             metric="total", mode="max",
             scheduler=tune.PopulationBasedTraining(
