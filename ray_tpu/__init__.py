@@ -37,6 +37,7 @@ def is_initialized() -> bool:
 
 def init(
     *,
+    address: str | None = None,
     num_cpus: float | None = None,
     num_tpus: float | None = None,
     resources: dict[str, float] | None = None,
@@ -46,7 +47,37 @@ def init(
     ignore_reinit_error: bool = False,
 ):
     """Start a single-host cluster (store daemon + GCS + raylet) and connect
-    this process as the driver."""
+    this process as the driver — or, with `address=`, connect to an EXISTING
+    cluster's GCS (the `ray.init(address=...)` analog; node discovery via
+    the GCS node table). `address="auto"` reads RT_ADDRESS from the
+    environment (set for job-submission drivers)."""
+    import os as _os
+
+    if address is not None:
+        if address == "auto":
+            address = _os.environ.get("RT_ADDRESS", "")
+            if not address:
+                raise RuntimeError('init(address="auto") needs RT_ADDRESS set')
+        if is_initialized():
+            if ignore_reinit_error:
+                return None
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        from ray_tpu._private.rpc import RpcClient
+
+        probe = RpcClient(address)
+        try:
+            nodes = [n for n in probe.call("get_nodes")["nodes"] if n["alive"]]
+        finally:
+            probe.close()
+        local = [n for n in nodes if n.get("store_socket")]
+        if not local:
+            raise RuntimeError(f"no connectable nodes registered at {address}")
+        connect(
+            gcs_address=address,
+            raylet_address=local[0]["address"],
+            store_socket=local[0]["store_socket"],
+        )
+        return None
     global _node_handle
     with _init_lock:
         if is_initialized():

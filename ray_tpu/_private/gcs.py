@@ -142,6 +142,7 @@ class GcsService:
                 "address": p["address"],
                 "resources": p["resources"],
                 "labels": p.get("labels", {}),
+                "store_socket": p.get("store_socket", ""),
                 "alive": True,
                 "last_heartbeat": time.monotonic(),
             }
@@ -161,6 +162,8 @@ class GcsService:
                 info["available"] = p["available"]
             if "load" in p:
                 info["load"] = p["load"]
+            if "pending_shapes" in p:
+                info["pending_shapes"] = p["pending_shapes"]
         return {"ok": True}
 
     def rpc_drain_node(self, conn, msgid, p):
@@ -182,6 +185,9 @@ class GcsService:
                         "labels": n["labels"],
                         "alive": n["alive"],
                         "available": n.get("available", n["resources"]),
+                        "load": n.get("load", 0),
+                        "pending_shapes": n.get("pending_shapes", []),
+                        "store_socket": n.get("store_socket", ""),
                     }
                     for nid, n in self.nodes.items()
                 ]

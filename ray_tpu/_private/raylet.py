@@ -107,6 +107,7 @@ class Raylet:
                 "address": self.address,
                 "resources": self.resources,
                 "labels": self.labels,
+                "store_socket": store_socket,
             },
         )
         self._threads = [
@@ -138,9 +139,18 @@ class Raylet:
                 with self._lock:
                     avail = dict(self.available)
                     load = len(self._queued)
+                    # resource shapes of queued work — the autoscaler
+                    # bin-packs these onto node types (reference:
+                    # resource_demand_scheduler.py:102 get_nodes_to_launch)
+                    shapes = [dict(s["resources"]) for s in self._queued[:100]]
                 self.gcs.call(
                     "heartbeat",
-                    {"node_id": self.node_id.binary(), "available": avail, "load": load},
+                    {
+                        "node_id": self.node_id.binary(),
+                        "available": avail,
+                        "load": load,
+                        "pending_shapes": shapes,
+                    },
                 )
                 nodes = self.gcs.call("get_nodes")["nodes"]
                 with self._lock:

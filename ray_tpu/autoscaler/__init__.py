@@ -1,0 +1,21 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Equivalent of the reference's autoscaler (reference: python/ray/autoscaler —
+SURVEY.md §2.2 P10/P11). Node types are whole TPU slices, so scale-up is
+slice-granular; providers are pluggable (fake in-process provider for tests,
+cloud providers implement the same 4-method contract).
+"""
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider, NodeProvider
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    NodeTypeConfig,
+    get_nodes_to_launch,
+)
+
+__all__ = [
+    "FakeMultiNodeProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+    "StandardAutoscaler",
+    "get_nodes_to_launch",
+]

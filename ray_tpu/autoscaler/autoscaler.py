@@ -1,0 +1,143 @@
+"""StandardAutoscaler: demand-driven scale-up, idle-timeout scale-down.
+
+Equivalent of the reference's StandardAutoscaler + Monitor
+(reference: python/ray/autoscaler/_private/autoscaler.py:171 update loop;
+monitor.py:126 head-side process reading demand from the GCS). Runs as a
+thread (or call update() manually in tests): reads per-node pending shapes
+and availability from GCS heartbeats, bin-packs unmet demand onto node
+types, launches through the NodeProvider, and terminates nodes idle past
+the timeout (never below min_workers).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ray_tpu._private.rpc import RpcClient
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    NodeTypeConfig,
+    get_nodes_to_launch,
+)
+
+
+class StandardAutoscaler:
+    def __init__(
+        self,
+        gcs_address: str,
+        provider: NodeProvider,
+        node_types: dict[str, NodeTypeConfig],
+        idle_timeout_s: float = 30.0,
+        update_interval_s: float = 1.0,
+    ):
+        self.provider = provider
+        self.node_types = dict(node_types)
+        self.idle_timeout_s = idle_timeout_s
+        self.update_interval_s = update_interval_s
+        self._gcs = RpcClient(gcs_address)
+        self._idle_since: dict[str, float] = {}  # provider id -> ts
+        self._launched_at: dict[str, float] = {}  # provider id -> ts
+        self.launch_grace_s = 120.0  # registration deadline for new nodes
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_status: dict = {}
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="autoscaler"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._gcs.close()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self.update_interval_s):
+            try:
+                self.update()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                if self._stopped.is_set():
+                    return
+
+    # -- one reconcile pass (reference: autoscaler.py:171 update) --
+
+    def update(self) -> dict:
+        nodes = {
+            n["node_id"]: n
+            for n in self._gcs.call("get_nodes")["nodes"]
+            if n["alive"]
+        }
+        managed = self.provider.non_terminated_nodes()
+        counts: dict[str, int] = {}
+        for pid, t in managed.items():
+            counts[t] = counts.get(t, 0) + 1
+
+        demands: list[dict] = []
+        capacity: list[dict] = []
+        for n in nodes.values():
+            demands.extend(n.get("pending_shapes", []))
+            capacity.append(dict(n.get("available", n["resources"])))
+
+        to_launch = get_nodes_to_launch(
+            self.node_types, counts, capacity, demands
+        )
+        for t, count in to_launch.items():
+            for _ in range(count):
+                pid = self.provider.create_node(
+                    t, dict(self.node_types[t].resources)
+                )
+                self._launched_at[pid] = time.monotonic()
+
+        terminated = self._scale_down(nodes, managed, counts, to_launch)
+        self.last_status = {
+            "demand_shapes": len(demands),
+            "launched": dict(to_launch),
+            "terminated": terminated,
+            "managed_nodes": len(managed),
+        }
+        return self.last_status
+
+    def _scale_down(self, nodes, managed, counts, just_launched) -> list[str]:
+        """Terminate provider nodes idle past the timeout (reference:
+        autoscaler idle node termination; keeps min_workers per type)."""
+        now = time.monotonic()
+        terminated: list[str] = []
+        for pid, t in list(managed.items()):
+            internal = self.provider.internal_id(pid)
+            info = nodes.get(internal)
+            if info is None:
+                # not in the GCS: failed/slow launch. Terminate past the
+                # grace deadline or the node leaks forever while eating the
+                # type's max_workers budget.
+                launched = self._launched_at.setdefault(pid, now)
+                if now - launched > self.launch_grace_s:
+                    self.provider.terminate_node(pid)
+                    self._launched_at.pop(pid, None)
+                    counts[t] = counts.get(t, 0) - 1
+                    terminated.append(pid)
+                continue
+            self._launched_at.pop(pid, None)  # registered — clear the clock
+            avail = info.get("available", info["resources"])
+            busy = (
+                any(avail.get(k, 0) < v for k, v in info["resources"].items())
+                or info.get("load", 0) > 0
+                or info.get("pending_shapes")
+            )
+            if busy:
+                self._idle_since.pop(pid, None)
+                continue
+            since = self._idle_since.setdefault(pid, now)
+            if now - since < self.idle_timeout_s:
+                continue
+            cfg = self.node_types.get(t)
+            floor = cfg.min_workers if cfg else 0
+            if counts.get(t, 0) + just_launched.get(t, 0) <= floor:
+                continue
+            self.provider.terminate_node(pid)
+            counts[t] = counts.get(t, 0) - 1
+            self._idle_since.pop(pid, None)
+            terminated.append(pid)
+        return terminated

@@ -1,0 +1,71 @@
+"""NodeProvider interface + fake in-process provider.
+
+Equivalent of the reference's pluggable provider layer
+(reference: python/ray/autoscaler/node_provider.py:13 NodeProvider;
+fake multi-node provider python/ray/autoscaler/_private/fake_multi_node/
+node_provider.py:237 used for autoscaler tests without a cloud,
+SURVEY.md §4.3). A cloud provider implements the same 4 methods against
+its VM API (the reference's GCP TPU pods: autoscaler/gcp/tpu.yaml).
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any
+
+
+class NodeProvider:
+    """Minimal provider contract (reference: node_provider.py:13)."""
+
+    def create_node(self, node_type: str, resources: dict[str, float]) -> str:
+        """Launch one node; returns provider node id."""
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> dict[str, str]:
+        """provider node id -> node_type."""
+        raise NotImplementedError
+
+    def internal_id(self, node_id: str) -> bytes | None:
+        """Cluster node id (GCS) for a provider node, once registered."""
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Backs provider nodes with in-process raylets on the test Cluster
+    (reference: RAY_FAKE_CLUSTER=1 fake_multi_node provider)."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._lock = threading.Lock()
+        self._nodes: dict[str, Any] = {}  # provider id -> (type, raylet)
+
+    def create_node(self, node_type: str, resources: dict[str, float]) -> str:
+        res = dict(resources)
+        raylet = self._cluster.add_node(
+            num_cpus=res.pop("CPU", 1),
+            num_tpus=res.pop("TPU", 0),
+            resources=res,
+            labels={"rt-node-type": node_type, "rt-autoscaled": "1"},
+        )
+        pid = f"fake-{uuid.uuid4().hex[:8]}"
+        with self._lock:
+            self._nodes[pid] = (node_type, raylet)
+        return pid
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            entry = self._nodes.pop(node_id, None)
+        if entry is not None:
+            self._cluster.remove_node(entry[1])
+
+    def non_terminated_nodes(self) -> dict[str, str]:
+        with self._lock:
+            return {pid: t for pid, (t, _r) in self._nodes.items()}
+
+    def internal_id(self, node_id: str) -> bytes | None:
+        with self._lock:
+            entry = self._nodes.get(node_id)
+        return entry[1].node_id.binary() if entry else None

@@ -1,0 +1,86 @@
+"""Demand bin-packing: pending resource shapes → nodes to launch.
+
+Equivalent of the reference's ResourceDemandScheduler
+(reference: python/ray/autoscaler/_private/resource_demand_scheduler.py:102
+get_nodes_to_launch, :170 bin-packing over node types). TPU-first: node
+types describe whole slices (e.g. a v5e-4 host = {"CPU": 8, "TPU": 4});
+a TPU-shaped demand packs onto slice types only, so scale-up happens in
+slice granularity (SURVEY.md §7 item 11).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeTypeConfig:
+    resources: dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+def _fits(shape: dict[str, float], capacity: dict[str, float]) -> bool:
+    return all(capacity.get(k, 0.0) >= v for k, v in shape.items() if v > 0)
+
+
+def _subtract(capacity: dict[str, float], shape: dict[str, float]) -> None:
+    for k, v in shape.items():
+        capacity[k] = capacity.get(k, 0.0) - v
+
+
+def get_nodes_to_launch(
+    node_types: dict[str, NodeTypeConfig],
+    current_counts: dict[str, int],
+    available_capacity: list[dict[str, float]],
+    pending_demands: list[dict[str, float]],
+) -> dict[str, int]:
+    """Bin-pack unmet demands onto hypothetical new nodes.
+
+    available_capacity: one dict per live node (its CURRENT free resources).
+    Returns {node_type: count_to_launch}, bounded by per-type max_workers.
+    """
+    to_launch: dict[str, int] = {}
+    counts = dict(current_counts)
+
+    # respect min_workers first
+    for t, cfg in node_types.items():
+        deficit = cfg.min_workers - counts.get(t, 0)
+        if deficit > 0:
+            to_launch[t] = to_launch.get(t, 0) + deficit
+            counts[t] = counts.get(t, 0) + deficit
+
+    capacity = [dict(c) for c in available_capacity]
+    # capacity of nodes we just decided to launch
+    for t, n in to_launch.items():
+        capacity.extend(dict(node_types[t].resources) for _ in range(n))
+
+    # largest demands first pack tighter (standard first-fit-decreasing)
+    demands = sorted(
+        (d for d in pending_demands if d),
+        key=lambda d: -sum(d.values()),
+    )
+    for shape in demands:
+        placed = False
+        for cap in capacity:
+            if _fits(shape, cap):
+                _subtract(cap, shape)
+                placed = True
+                break
+        if placed:
+            continue
+        # launch the smallest node type that can hold the shape
+        candidates = [
+            (sum(cfg.resources.values()), t)
+            for t, cfg in node_types.items()
+            if _fits(shape, cfg.resources)
+            and counts.get(t, 0) < cfg.max_workers
+        ]
+        if not candidates:
+            continue  # infeasible or at the cap — surfaced via status
+        _, t = min(candidates)
+        to_launch[t] = to_launch.get(t, 0) + 1
+        counts[t] = counts.get(t, 0) + 1
+        new_cap = dict(node_types[t].resources)
+        _subtract(new_cap, shape)
+        capacity.append(new_cap)
+    return to_launch

@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.dashboard import Dashboard, start_dashboard
+
+__all__ = ["Dashboard", "start_dashboard"]

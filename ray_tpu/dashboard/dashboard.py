@@ -1,0 +1,144 @@
+"""Dashboard: REST backend for cluster state + job submission.
+
+Equivalent of the reference's dashboard head REST surface
+(reference: dashboard/head.py + module system dashboard/modules/* — node,
+actor, state, job REST endpoints; job REST dashboard/modules/job/job_head.py).
+The reference's React client is UI-only and out of scope; every endpoint
+here returns JSON suitable for curl/CLI consumption.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+from ray_tpu.dashboard.job_manager import JobManager
+
+
+class Dashboard:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1", port: int = 8265,
+                 log_dir: str | None = None):
+        import tempfile
+
+        self.gcs_address = gcs_address
+        self.host = host
+        self.port = port
+        self.jobs = JobManager(
+            gcs_address, log_dir or tempfile.mkdtemp(prefix="rt_job_logs_")
+        )
+        self._loop = None
+        self._started = threading.Event()
+        self._start_error: Exception | None = None
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "Dashboard":
+        t = threading.Thread(target=self._serve, daemon=True, name="dashboard")
+        t.start()
+        if not self._started.wait(15):
+            raise RuntimeError("dashboard failed to start")
+        if self._start_error:
+            raise self._start_error
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+    # -- server --
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        from ray_tpu.util import state
+
+        def offload(fn, *args):
+            return asyncio.get_event_loop().run_in_executor(None, fn, *args)
+
+        async def nodes(request):
+            return web.json_response({"nodes": await offload(state.list_nodes)})
+
+        async def actors(request):
+            return web.json_response({"actors": await offload(state.list_actors)})
+
+        async def tasks(request):
+            return web.json_response({"tasks": await offload(state.list_tasks)})
+
+        async def cluster(request):
+            return web.json_response(await offload(state.summary))
+
+        async def submit_job(request):
+            body = await request.json()
+            try:
+                job_id = await offload(
+                    lambda: self.jobs.submit(
+                        body["entrypoint"],
+                        submission_id=body.get("submission_id"),
+                        env=body.get("env"),
+                        cwd=body.get("cwd"),
+                    )
+                )
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            return web.json_response({"job_id": job_id})
+
+        async def list_jobs(request):
+            return web.json_response({"jobs": await offload(self.jobs.list)})
+
+        async def job_status(request):
+            try:
+                st = await offload(self.jobs.status, request.match_info["job_id"])
+            except KeyError:
+                return web.json_response({"error": "no such job"}, status=404)
+            return web.json_response(st)
+
+        async def job_logs(request):
+            try:
+                logs = await offload(self.jobs.logs, request.match_info["job_id"])
+            except KeyError:
+                return web.json_response({"error": "no such job"}, status=404)
+            return web.json_response({"logs": logs})
+
+        async def stop_job(request):
+            try:
+                stopped = await offload(self.jobs.stop, request.match_info["job_id"])
+            except KeyError:
+                return web.json_response({"error": "no such job"}, status=404)
+            return web.json_response({"stopped": stopped})
+
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        app = web.Application()
+        app.router.add_get("/api/nodes", nodes)
+        app.router.add_get("/api/actors", actors)
+        app.router.add_get("/api/tasks", tasks)
+        app.router.add_get("/api/cluster_status", cluster)
+        app.router.add_post("/api/jobs", submit_job)
+        app.router.add_get("/api/jobs", list_jobs)
+        app.router.add_get("/api/jobs/{job_id}", job_status)
+        app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
+        app.router.add_post("/api/jobs/{job_id}/stop", stop_job)
+        runner = web.AppRunner(app)
+        try:
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+        except Exception as e:  # noqa: BLE001
+            self._start_error = e
+            self._started.set()
+            return
+        self._started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+
+def start_dashboard(gcs_address: str | None = None, port: int = 8265) -> Dashboard:
+    """Start the dashboard against the current (or given) cluster."""
+    if gcs_address is None:
+        import ray_tpu
+
+        gcs_address = ray_tpu.worker.global_worker().gcs.address
+    return Dashboard(gcs_address, port=port).start()

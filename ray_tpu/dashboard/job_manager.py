@@ -1,0 +1,141 @@
+"""JobManager — run submitted entrypoints as driver subprocesses.
+
+Equivalent of the reference's JobManager
+(reference: dashboard/modules/job/job_manager.py — drivers run as
+subprocesses on the cluster with RAY_ADDRESS set; status + logs tracked per
+job). Submitted entrypoints get RT_ADDRESS so `ray_tpu.init(address="auto")`
+connects them to this cluster.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+
+
+PENDING, RUNNING, SUCCEEDED, FAILED, STOPPED = (
+    "PENDING", "RUNNING", "SUCCEEDED", "FAILED", "STOPPED",
+)
+
+
+class JobManager:
+    def __init__(self, gcs_address: str, log_dir: str):
+        self.gcs_address = gcs_address
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._jobs: dict[str, dict] = {}
+
+    def submit(
+        self,
+        entrypoint: str,
+        *,
+        submission_id: str | None = None,
+        env: dict[str, str] | None = None,
+        cwd: str | None = None,
+    ) -> str:
+        job_id = submission_id or f"rtjob-{uuid.uuid4().hex[:10]}"
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id!r} already exists")
+        log_path = os.path.join(self.log_dir, f"{job_id}.log")
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        full_env["RT_ADDRESS"] = self.gcs_address
+        full_env["RT_JOB_ID"] = job_id
+        log_f = open(log_path, "wb")
+        proc = subprocess.Popen(
+            entrypoint, shell=True, env=full_env, cwd=cwd,
+            stdout=log_f, stderr=subprocess.STDOUT,
+        )
+        with self._lock:
+            self._jobs[job_id] = {
+                "job_id": job_id,
+                "entrypoint": entrypoint,
+                "proc": proc,
+                "log_file": log_f,
+                "log_path": log_path,
+                "status": RUNNING,
+                "start_time": time.time(),
+                "end_time": None,
+            }
+        return job_id
+
+    def _refresh(self, j: dict) -> None:
+        proc = j["proc"]
+        if j["status"] == RUNNING and proc is not None:
+            rc = proc.poll()
+            if rc is not None:
+                j["status"] = SUCCEEDED if rc == 0 else FAILED
+                j["end_time"] = time.time()
+                j["log_file"].close()
+
+    def status(self, job_id: str) -> dict:
+        with self._lock:
+            j = self._jobs.get(job_id)
+            if j is None:
+                raise KeyError(job_id)
+            self._refresh(j)
+            return {
+                k: j[k]
+                for k in ("job_id", "entrypoint", "status", "start_time", "end_time")
+            }
+
+    def logs(self, job_id: str) -> str:
+        with self._lock:
+            j = self._jobs.get(job_id)
+            if j is None:
+                raise KeyError(job_id)
+            path = j["log_path"]
+        try:
+            with open(path, "r", errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            j = self._jobs.get(job_id)
+            if j is None:
+                raise KeyError(job_id)
+            proc = j["proc"]
+            if j["status"] != RUNNING or proc.poll() is not None:
+                return False
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()  # SIGTERM trapped — escalate so STOPPED means stopped
+            proc.wait(timeout=5)
+        with self._lock:
+            j["status"] = STOPPED
+            j["end_time"] = time.time()
+            j["log_file"].close()
+        return True
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            out = []
+            for j in self._jobs.values():
+                self._refresh(j)
+                out.append(
+                    {
+                        k: j[k]
+                        for k in (
+                            "job_id", "entrypoint", "status",
+                            "start_time", "end_time",
+                        )
+                    }
+                )
+            return out
+
+    def wait(self, job_id: str, timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.status(job_id)["status"]
+            if st in (SUCCEEDED, FAILED, STOPPED):
+                return st
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} still running after {timeout}s")

@@ -1,0 +1,75 @@
+"""JobSubmissionClient — HTTP SDK for the dashboard's job REST API.
+
+Equivalent of the reference's job SDK
+(reference: dashboard/modules/job/sdk.py:40 JobSubmissionClient,
+submit_job :130; REST served by job_head.py). Talks plain HTTP so jobs can
+be submitted to a remote head from any machine.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """address: dashboard URL, e.g. 'http://127.0.0.1:8265'."""
+        self.address = address.rstrip("/")
+
+    def _request(self, method: str, path: str, body: dict | None = None) -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.address + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.load(e)
+            except Exception:  # noqa: BLE001
+                detail = {"error": str(e)}
+            raise RuntimeError(f"job API {path}: {detail.get('error', detail)}") from None
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: str | None = None,
+        env: dict[str, str] | None = None,
+        cwd: str | None = None,
+    ) -> str:
+        out = self._request(
+            "POST", "/api/jobs",
+            {"entrypoint": entrypoint, "submission_id": submission_id,
+             "env": env, "cwd": cwd},
+        )
+        return out["job_id"]
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{job_id}")["status"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        return self._request("GET", f"/api/jobs/{job_id}")
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{job_id}/logs")["logs"]
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._request("POST", f"/api/jobs/{job_id}/stop")["stopped"]
+
+    def list_jobs(self) -> list[dict]:
+        return self._request("GET", "/api/jobs")["jobs"]
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.get_job_status(job_id)
+            if st in ("SUCCEEDED", "FAILED", "STOPPED"):
+                return st
+            time.sleep(0.25)
+        raise TimeoutError(f"job {job_id} still running after {timeout}s")

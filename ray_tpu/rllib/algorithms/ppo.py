@@ -46,8 +46,10 @@ def ppo_loss(module, params, batch, config):
     """Clipped surrogate + value loss + entropy bonus (pure jax)."""
     import jax.numpy as jnp
 
+    import jax
+
     logits, values = module.forward(params, batch["obs"])
-    logp_all = _log_softmax(logits)
+    logp_all = jax.nn.log_softmax(logits)
     logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=-1)[:, 0]
     ratio = jnp.exp(logp - batch["logp_old"])
     clip = config["clip_param"]
@@ -70,13 +72,6 @@ def ppo_loss(module, params, batch, config):
         "mean_kl": jnp.mean(batch["logp_old"] - logp),
     }
     return total, metrics
-
-
-def _log_softmax(logits):
-    import jax.numpy as jnp
-
-    z = logits - jnp.max(logits, axis=-1, keepdims=True)
-    return z - jnp.log(jnp.sum(jnp.exp(z), axis=-1, keepdims=True))
 
 
 class PPOConfig(AlgorithmConfig):

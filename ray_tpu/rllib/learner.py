@@ -56,9 +56,9 @@ class Learner:
             # batch sharded over the data axis; params replicated — XLA
             # derives the grad all-reduce (idiomatic dp, no DDP object)
             self._batch_sharding = NamedSharding(mesh, P(AxisNames.DATA))
-            replicated = NamedSharding(mesh, P())
-            self.params = jax.device_put(self.params, replicated)
-            self.opt_state = jax.device_put(self.opt_state, replicated)
+            self._replicated_sharding = NamedSharding(mesh, P())
+            self.params = jax.device_put(self.params, self._replicated_sharding)
+            self.opt_state = jax.device_put(self.opt_state, self._replicated_sharding)
 
     def _update_impl(self, params, opt_state, batch):
         import jax
@@ -81,7 +81,17 @@ class Learner:
         import jax
 
         if self._batch_sharding is not None:
-            batch = jax.device_put(batch, self._batch_sharding)
+            # only top-level arrays are per-example data; nested pytrees
+            # (e.g. DQN's target_params riding in the batch) replicate
+            batch = {
+                k: jax.device_put(
+                    v,
+                    self._batch_sharding
+                    if isinstance(v, np.ndarray)
+                    else self._replicated_sharding,
+                )
+                for k, v in batch.items()
+            }
         self.params, self.opt_state, metrics = self._update_jit(
             self.params, self.opt_state, batch
         )

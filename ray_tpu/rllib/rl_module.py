@@ -29,6 +29,17 @@ def _init_linear(rng: np.random.Generator, n_in: int, n_out: int, scale: float):
     }
 
 
+def _mlp_jax(layers, x):
+    """jax twin of ActorCriticModule._mlp_np — shared by every module's
+    learner-side forward."""
+    import jax.numpy as jnp
+
+    for layer in layers[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
 class ActorCriticModule:
     """Tanh-MLP trunk with separate policy/value heads (discrete actions)."""
 
@@ -84,16 +95,8 @@ class ActorCriticModule:
 
     def forward(self, params, obs):
         """Same math in jax; called inside the jitted learner update."""
-        import jax.numpy as jnp
-
-        def mlp(layers, x):
-            for layer in layers[:-1]:
-                x = jnp.tanh(x @ layer["w"] + layer["b"])
-            last = layers[-1]
-            return x @ last["w"] + last["b"]
-
-        logits = mlp(params["pi"], obs)
-        values = mlp(params["vf"], obs)[:, 0]
+        logits = _mlp_jax(params["pi"], obs)
+        values = _mlp_jax(params["vf"], obs)[:, 0]
         return logits, values
 
 
@@ -119,12 +122,4 @@ class QModule:
         return ActorCriticModule._mlp_np(params["q"], obs)
 
     def forward(self, params, obs):
-        import jax.numpy as jnp
-
-        def mlp(layers, x):
-            for layer in layers[:-1]:
-                x = jnp.tanh(x @ layer["w"] + layer["b"])
-            last = layers[-1]
-            return x @ last["w"] + last["b"]
-
-        return mlp(params["q"], obs)
+        return _mlp_jax(params["q"], obs)

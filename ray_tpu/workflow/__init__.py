@@ -1,0 +1,26 @@
+"""ray_tpu.workflow — durable DAG execution with storage checkpoints.
+
+Equivalent of the reference's Workflow library
+(reference: python/ray/workflow — api.py run/resume, task_executor.py,
+storage-backed step checkpoints workflow/storage/filesystem.py; built on
+the Ray DAG bind API python/ray/dag/). Steps are tasks on the distributed
+core; each step's result is checkpointed to the workflow's storage dir, so
+`resume` replays completed steps from disk and re-executes only the rest.
+"""
+from ray_tpu.workflow.api import (
+    WorkflowNode,
+    get_output,
+    list_workflows,
+    resume,
+    run,
+    step,
+)
+
+__all__ = [
+    "WorkflowNode",
+    "get_output",
+    "list_workflows",
+    "resume",
+    "run",
+    "step",
+]

@@ -1,0 +1,79 @@
+"""Dashboard REST + job submission + multi-driver connect
+(model: reference dashboard/modules/job/tests/test_job_manager.py and
+python/ray/tests/test_multi_tenancy driver separation)."""
+from __future__ import annotations
+
+import json
+import textwrap
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture()
+def dash(ray_start):
+    from ray_tpu.dashboard import start_dashboard
+
+    d = start_dashboard(port=18265)
+    yield ray_start, d
+    d.stop()
+
+
+def test_dashboard_state_endpoints(dash):
+    rt, d = dash
+
+    @rt.remote
+    def noop():
+        return 1
+
+    rt.get([noop.remote() for _ in range(2)], timeout=120)
+    import time
+
+    time.sleep(1.0)
+    with urllib.request.urlopen(d.address + "/api/cluster_status", timeout=30) as r:
+        status = json.load(r)
+    assert status["nodes"]["alive"] == 1
+    with urllib.request.urlopen(d.address + "/api/tasks", timeout=30) as r:
+        tasks = json.load(r)["tasks"]
+    assert any(t["name"] == "noop" for t in tasks)
+
+
+def test_job_submission_end_to_end(dash, tmp_path):
+    rt, d = dash
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    script = tmp_path / "job.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import sys
+            sys.path.insert(0, "/root/repo")
+            import ray_tpu
+            ray_tpu.init(address="auto")
+
+            @ray_tpu.remote
+            def double(x):
+                return x * 2
+
+            out = ray_tpu.get([double.remote(i) for i in range(4)], timeout=120)
+            print("JOB RESULT:", sum(out))
+            assert sum(out) == 12
+            """
+        )
+    )
+    client = JobSubmissionClient(d.address)
+    job_id = client.submit_job(entrypoint=f"python {script}")
+    final = client.wait_until_finished(job_id, timeout=240)
+    logs = client.get_job_logs(job_id)
+    assert final == "SUCCEEDED", logs
+    assert "JOB RESULT: 12" in logs
+    assert client.list_jobs()[0]["job_id"] == job_id
+
+
+def test_job_failure_reported(dash):
+    rt, d = dash
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(d.address)
+    job_id = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(job_id, timeout=120) == "FAILED"

@@ -1,0 +1,116 @@
+"""ActorPool, Queue, Workflow (model: reference python/ray/tests/
+test_actor_pool.py, test_queue.py, workflow tests)."""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import pytest
+
+
+def test_actor_pool_map_ordered(ray_start):
+    rt = ray_start
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @rt.remote
+    class Sq:
+        def f(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote(), Sq.remote()])
+    out = list(pool.map(lambda a, v: a.f.remote(v), range(8)))
+    assert out == [x * x for x in range(8)]
+
+
+def test_actor_pool_unordered_completes(ray_start):
+    rt = ray_start
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @rt.remote
+    class Sleepy:
+        def f(self, x):
+            import time
+
+            time.sleep(0.2 if x == 0 else 0.0)
+            return x
+
+    pool = ActorPool([Sleepy.remote(), Sleepy.remote()])
+    out = list(pool.map_unordered(lambda a, v: a.f.remote(v), range(4)))
+    assert sorted(out) == [0, 1, 2, 3]
+
+
+def test_queue_fifo_and_limits(ray_start):
+    rt = ray_start
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get_nowait()
+    assert q.empty()
+    q.shutdown()
+
+
+def test_workflow_run_and_resume(ray_start):
+    rt = ray_start
+    from ray_tpu import workflow
+
+    storage = tempfile.mkdtemp()
+    marker = os.path.join(storage, "runs.txt")
+
+    @workflow.step
+    def load(x):
+        with open(marker, "a") as f:
+            f.write("load\n")
+        return x * 2
+
+    @workflow.step
+    def combine(a, b):
+        return a + b
+
+    dag = combine.bind(load.bind(3), load.bind(4))
+    out = workflow.run(dag, workflow_id="wf1", storage=storage)
+    assert out == 14
+    assert open(marker).read().count("load") == 2
+
+    # resume: completed steps replay from checkpoints — no re-execution
+    out2 = workflow.resume(dag, workflow_id="wf1", storage=storage)
+    assert out2 == 14
+    assert open(marker).read().count("load") == 2
+
+    wfs = workflow.list_workflows(storage)
+    assert wfs and wfs[0]["status"] == "SUCCESSFUL"
+
+
+def test_workflow_partial_failure_resumes_frontier(ray_start):
+    rt = ray_start
+    from ray_tpu import workflow
+
+    storage = tempfile.mkdtemp()
+    flag = os.path.join(storage, "fail_once")
+    open(flag, "w").close()
+
+    @workflow.step
+    def first():
+        return 10
+
+    @workflow.step
+    def flaky2(x, flag_path=flag):
+        import os as _os
+
+        if _os.path.exists(flag_path):
+            _os.unlink(flag_path)
+            raise RuntimeError("transient failure")
+        return x + 1
+
+    dag = flaky2.bind(first.bind())
+    with pytest.raises(RuntimeError):
+        workflow.run(dag, workflow_id="wf2", storage=storage)
+    # first() checkpointed; resume only re-runs flaky2
+    out = workflow.resume(dag, workflow_id="wf2", storage=storage)
+    assert out == 11
