@@ -31,7 +31,9 @@ class Config:
     min_spilling_size: int = 1 * 1024 * 1024
     # --- raylet ---
     num_workers_soft_limit: int = -1  # default: num_cpus
-    worker_register_timeout_s: int = 30
+    # generous: several python workers cold-spawning serially on a loaded
+    # single-CPU host can take 5-10s each
+    worker_register_timeout_s: int = 60
     kill_idle_workers_interval_ms: int = 200
     idle_worker_killing_time_threshold_ms: int = 1000
     # --- GCS ---

@@ -28,7 +28,15 @@ from ray_tpu._private.rpc import RpcClient, RpcServer
 
 
 class GcsService:
-    def __init__(self):
+    def __init__(self, store=None):
+        """store: a StoreClient (store_client.py). File-backed stores give
+        head-restart tolerance — the reference's Redis-backed GCS mode
+        (redis_store_client.h:33); None/in-memory is the default mode."""
+        from ray_tpu._private.store_client import InMemoryStoreClient
+
+        self._store = store or InMemoryStoreClient()
+        self._dirty = 0
+        self._persisted = 0
         self._lock = threading.RLock()
         # namespace -> key -> value
         self._kv: dict[str, dict[bytes, bytes]] = defaultdict(dict)
@@ -51,16 +59,76 @@ class GcsService:
         self._stopped = threading.Event()
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._restore()
         self.server = RpcServer(self, host, port)
         self._health_thread.start()
+        # snapshotting every table under the lock is pure overhead when the
+        # store is the no-op in-memory default — only run it for real stores
+        if getattr(self._store, "persistent", True):
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, daemon=True, name="gcs-persist"
+            )
+            self._persist_thread.start()
         return self.server.address
 
     def stop(self) -> None:
         self._stopped.set()
+        self._persist_now()
         for c in self._raylet_clients.values():
             c.close()
         if self.server:
             self.server.stop()
+
+    # ---------------- persistence (GCS FT) ----------------
+
+    def _mark_dirty(self) -> None:
+        self._dirty += 1
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "kv": {ns: dict(d) for ns, d in self._kv.items()},
+                # connections don't survive a restart; nodes re-register on
+                # their next heartbeat (raylet reregister path)
+                "actors": {
+                    aid: dict(a) for aid, a in self.actors.items()
+                },
+                "placement_groups": {
+                    pid: dict(p) for pid, p in self.placement_groups.items()
+                },
+                "job_counter": self._job_counter,
+                "task_events": list(self._task_events),
+            }
+
+    def _persist_now(self) -> None:
+        if not getattr(self._store, "persistent", True):
+            return
+        with self._lock:
+            version = self._dirty
+            if version == self._persisted:
+                return
+        try:
+            self._store.save(self._snapshot())
+            with self._lock:
+                self._persisted = version
+        except Exception:  # noqa: BLE001 — persistence must not kill the GCS
+            pass
+
+    def _persist_loop(self) -> None:
+        while not self._stopped.wait(0.2):
+            self._persist_now()
+
+    def _restore(self) -> None:
+        snap = self._store.load()
+        if not snap:
+            return
+        with self._lock:
+            for ns, d in snap.get("kv", {}).items():
+                self._kv[ns].update(d)
+            self.actors.update(snap.get("actors", {}))
+            self.placement_groups.update(snap.get("placement_groups", {}))
+            self._job_counter = snap.get("job_counter", 0)
+            self._task_events = list(snap.get("task_events", []))
 
     # ---------------- internal helpers ----------------
 
@@ -119,6 +187,7 @@ class GcsService:
             existed = p["key"] in ns
             if p.get("overwrite", True) or not existed:
                 ns[p["key"]] = p["value"]
+            self._mark_dirty()
         return {"added": not existed}
 
     def rpc_kv_get(self, conn, msgid, p):
@@ -127,7 +196,9 @@ class GcsService:
 
     def rpc_kv_del(self, conn, msgid, p):
         with self._lock:
-            return {"deleted": self._kv[p.get("ns", "default")].pop(p["key"], None) is not None}
+            deleted = self._kv[p.get("ns", "default")].pop(p["key"], None) is not None
+            self._mark_dirty()
+            return {"deleted": deleted}
 
     def rpc_kv_keys(self, conn, msgid, p):
         prefix = p.get("prefix", b"")
@@ -211,6 +282,7 @@ class GcsService:
     def rpc_next_job_id(self, conn, msgid, p):
         with self._lock:
             self._job_counter += 1
+            self._mark_dirty()
             return {"job_id": self._job_counter.to_bytes(4, "little")}
 
     # ---------------- RPC: actors ----------------
@@ -226,6 +298,7 @@ class GcsService:
                 "num_restarts": 0,
                 "max_restarts": p.get("max_restarts", 0),
             }
+            self._mark_dirty()
         return {"ok": True}
 
     def rpc_update_actor(self, conn, msgid, p):
@@ -239,6 +312,7 @@ class GcsService:
             )
             if p.get("increment_restarts"):
                 actor["num_restarts"] += 1
+            self._mark_dirty()
             snapshot = dict(actor)
         self._publish("actor:" + aid.hex(), snapshot)
         return {"ok": True}
@@ -284,6 +358,7 @@ class GcsService:
                     "state": "PENDING",
                     "allocations": None,
                 }
+                self._mark_dirty()
             return {"ok": False, "state": "PENDING",
                     "reason": "infeasible or insufficient resources"}
 
@@ -342,6 +417,7 @@ class GcsService:
                     {"node_id": nid, "bundle_index": bi} for nid, bi in prepared
                 ],
             }
+            self._mark_dirty()
         self._publish("pg:" + pg_id.hex(), {"state": "CREATED"})
         return {"ok": True, "state": "CREATED",
                 "allocations": self.placement_groups[pg_id]["allocations"]}
@@ -362,6 +438,7 @@ class GcsService:
         with self._lock:
             if pg_id in self.placement_groups:
                 self.placement_groups[pg_id]["state"] = "REMOVED"
+            self._mark_dirty()
         return {"ok": True}
 
     def rpc_get_placement_group(self, conn, msgid, p):
@@ -400,6 +477,7 @@ class GcsService:
             overflow = len(self._task_events) - cfg.task_events_buffer_size
             if overflow > 0:
                 del self._task_events[:overflow]
+            self._mark_dirty()
         return {"ok": True}
 
     def rpc_list_task_events(self, conn, msgid, p):

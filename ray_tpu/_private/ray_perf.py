@@ -1,0 +1,79 @@
+"""Core microbenchmarks — tasks/s, actor calls/s, put/get throughput.
+
+Equivalent of the reference's `ray microbenchmark`
+(reference: python/ray/_private/ray_perf.py:1 — the CI gate for core
+regressions; release/benchmarks/README.md scalability envelope). Run:
+`python -m ray_tpu._private.ray_perf`.
+"""
+from __future__ import annotations
+
+import time
+
+
+def _rate(n: int, seconds: float) -> float:
+    return n / seconds if seconds > 0 else float("inf")
+
+
+def run_microbenchmarks(task_count: int = 200, call_count: int = 200,
+                        put_count: int = 100, put_mb: int = 1) -> dict:
+    import numpy as np
+
+    import ray_tpu
+
+    results: dict[str, float] = {}
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    # warm the worker pool so we measure steady-state dispatch, not spawn
+    ray_tpu.get([noop.remote() for _ in range(8)], timeout=120)
+
+    t0 = time.perf_counter()
+    ray_tpu.get([noop.remote() for _ in range(task_count)], timeout=300)
+    results["tasks_per_s"] = _rate(task_count, time.perf_counter() - t0)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote(), timeout=120)  # actor cold start
+    t0 = time.perf_counter()
+    ray_tpu.get([c.inc.remote() for _ in range(call_count)], timeout=300)
+    results["actor_calls_per_s"] = _rate(call_count, time.perf_counter() - t0)
+
+    payload = np.zeros(put_mb * 1024 * 1024, np.uint8)
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(payload) for _ in range(put_count)]
+    results["put_mb_per_s"] = _rate(put_count * put_mb, time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for r in refs:
+        ray_tpu.get(r, timeout=60)
+    results["get_mb_per_s"] = _rate(put_count * put_mb, time.perf_counter() - t0)
+    return results
+
+
+def main() -> None:
+    import json
+
+    import ray_tpu
+
+    owns_cluster = not ray_tpu.is_initialized()
+    if owns_cluster:
+        ray_tpu.init(object_store_memory=512 * 1024 * 1024)
+    try:
+        results = run_microbenchmarks()
+        print(json.dumps({k: round(v, 1) for k, v in results.items()}))
+    finally:
+        if owns_cluster:
+            ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -143,7 +143,7 @@ class Raylet:
                     # bin-packs these onto node types (reference:
                     # resource_demand_scheduler.py:102 get_nodes_to_launch)
                     shapes = [dict(s["resources"]) for s in self._queued[:100]]
-                self.gcs.call(
+                reply = self.gcs.call(
                     "heartbeat",
                     {
                         "node_id": self.node_id.binary(),
@@ -152,6 +152,19 @@ class Raylet:
                         "pending_shapes": shapes,
                     },
                 )
+                if reply.get("reregister"):
+                    # the GCS restarted and lost the node table — re-announce
+                    # (reference: node_manager.cc:1168 HandleNotifyGCSRestart)
+                    self.gcs.call(
+                        "register_node",
+                        {
+                            "node_id": self.node_id.binary(),
+                            "address": self.address,
+                            "resources": self.resources,
+                            "labels": self.labels,
+                            "store_socket": self.store_socket,
+                        },
+                    )
                 nodes = self.gcs.call("get_nodes")["nodes"]
                 with self._lock:
                     self._cluster_view = {
@@ -160,6 +173,17 @@ class Raylet:
             except Exception:
                 if self._stopped.is_set():
                     return
+                # GCS may be restarting: rebuild the client connection and
+                # retry next tick (reference: gcs reconnect timeout,
+                # ray_config_def.h:65)
+                try:
+                    self.gcs.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                try:
+                    self.gcs = RpcClient(self.gcs_address)
+                except Exception:  # noqa: BLE001
+                    pass
 
     # ------------- dependency resolution -------------
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 import traceback
 from concurrent.futures import Future
 from typing import Any, Callable
@@ -108,7 +109,23 @@ class RpcServer:
         self.service = service
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
+        if port == 0:
+            self._srv.bind((host, port))
+        else:
+            # fixed ports are used for restart-in-place (GCS FT); lingering
+            # sockets from the previous incarnation can hold the port for a
+            # moment — retry EADDRINUSE briefly; other errors fail fast
+            import errno
+
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    self._srv.bind((host, port))
+                    break
+                except OSError as e:
+                    if e.errno != errno.EADDRINUSE or time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
         self._srv.listen(512)
         self.address = f"{host}:{self._srv.getsockname()[1]}"
         self._stopped = threading.Event()
@@ -165,6 +182,14 @@ class RpcServer:
 
     def stop(self) -> None:
         self._stopped.set()
+        try:
+            # shutdown() first: a thread parked in accept() holds the fd
+            # alive through CPython's close(), leaving the port LISTENING
+            # forever; shutdown wakes it so close() actually releases the
+            # port (restart-in-place depends on this)
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._srv.close()
         except OSError:
@@ -224,15 +249,28 @@ class RpcClient:
                     self._notify_handler(topic, payload)
                 except Exception:
                     traceback.print_exc()
-        # Connection lost: fail all pending calls.
+        # Connection lost: fail all pending calls AND every future call —
+        # a send after this point can land in the kernel buffer without
+        # error and would otherwise pend forever. _dead is set under
+        # _pending_lock so a racing call_async either sees the flag or has
+        # its future registered before the sweep below.
         with self._pending_lock:
+            self._dead = True
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError(f"connection to {self.address} lost"))
             self._pending.clear()
 
+    _dead = False
+
     def call_async(self, method: str, payload: Any = None) -> Future:
         with self._pending_lock:
+            if self._dead:
+                fut: Future = Future()
+                fut.set_exception(
+                    ConnectionError(f"connection to {self.address} lost")
+                )
+                return fut
             self._msgid += 1
             msgid = self._msgid
             fut: Future = Future()

@@ -1,0 +1,74 @@
+"""Runtime environments: per-task/actor env application.
+
+Equivalent of the reference's runtime_env subsystem, narrowed to the
+single-host fields (reference: python/ray/runtime_env/ +
+python/ray/_private/runtime_env/ — plugin base plugin.py:264; the
+conda/pip/container plugins need an agent + package store and are out of
+scope this round; design doc python/ray/runtime_env/ARCHITECTURE.md).
+
+Supported fields:
+  * env_vars: {name: value} — set for the task's duration (actor lifetime
+    for actor-creation tasks, since the process is dedicated).
+  * working_dir: local directory — cwd for the task's duration. Local path
+    only (the reference ships zips through its GCS package store).
+  * py_modules: list of local dirs prepended to sys.path.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+
+_KNOWN = {"env_vars", "working_dir", "py_modules"}
+
+
+def validate_runtime_env(env: dict | None) -> None:
+    if not env:
+        return
+    unknown = set(env) - _KNOWN
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env fields {sorted(unknown)}; supported: "
+            f"{sorted(_KNOWN)}"
+        )
+    wd = env.get("working_dir")
+    if wd is not None and not os.path.isdir(wd):
+        raise ValueError(f"runtime_env working_dir {wd!r} is not a directory")
+
+
+@contextlib.contextmanager
+def applied_runtime_env(env: dict | None, *, permanent: bool = False):
+    """Apply env for the duration of the block; `permanent=True` (actor
+    creation — the worker process is dedicated to the actor) skips the
+    restore so the environment outlives the creation task."""
+    if not env:
+        yield
+        return
+    saved_env: dict[str, str | None] = {}
+    saved_cwd = None
+    saved_path = None
+    for k, v in (env.get("env_vars") or {}).items():
+        saved_env[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    wd = env.get("working_dir")
+    if wd:
+        saved_cwd = os.getcwd()
+        os.chdir(wd)
+    mods = env.get("py_modules") or []
+    if mods:
+        saved_path = list(sys.path)
+        for m in reversed(mods):
+            sys.path.insert(0, m)
+    try:
+        yield
+    finally:
+        if not permanent:
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            if saved_cwd is not None:
+                os.chdir(saved_cwd)
+            if saved_path is not None:
+                sys.path[:] = saved_path

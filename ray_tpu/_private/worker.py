@@ -323,13 +323,19 @@ class CoreWorker:
             event="RUNNING", task_type=spec["type"],
         )
         self._last_task_failed = False
+        from ray_tpu._private.runtime_env import applied_runtime_env
+
         try:
-            if spec["type"] == ts.ACTOR_CREATION:
-                self._execute_actor_creation(spec)
-            elif spec["type"] == ts.ACTOR_TASK:
-                self._execute_actor_method(spec)
-            else:
-                self._execute_normal(spec)
+            with applied_runtime_env(
+                spec.get("runtime_env"),
+                permanent=spec["type"] == ts.ACTOR_CREATION,
+            ):
+                if spec["type"] == ts.ACTOR_CREATION:
+                    self._execute_actor_creation(spec)
+                elif spec["type"] == ts.ACTOR_TASK:
+                    self._execute_actor_method(spec)
+                else:
+                    self._execute_normal(spec)
         finally:
             self.task_events.record(
                 task_id=spec["task_id"], job_id=spec["job_id"],

@@ -31,6 +31,9 @@ class ActorClass:
         self._name = name
         self._lifetime = lifetime
         self._scheduling_strategy = scheduling_strategy
+        from ray_tpu._private.runtime_env import validate_runtime_env
+
+        validate_runtime_env(runtime_env)
         self._runtime_env = runtime_env
 
     def __call__(self, *a, **k):
@@ -53,6 +56,10 @@ class ActorClass:
         for key in ("max_restarts", "name", "lifetime", "scheduling_strategy", "runtime_env"):
             if key in opts:
                 setattr(clone, "_" + key, opts[key])
+        if "runtime_env" in opts:
+            from ray_tpu._private.runtime_env import validate_runtime_env
+
+            validate_runtime_env(clone._runtime_env)
         return clone
 
     def remote(self, *args, **kwargs) -> "ActorHandle":

@@ -1,11 +1,23 @@
 from ray_tpu.models.gpt import GPTConfig, gpt_forward, gpt_init, gpt_param_axes
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_loss,
+    llama_param_axes,
+)
 from ray_tpu.models.resnet import ResNet50, resnet_init
 
 __all__ = [
     "GPTConfig",
+    "LlamaConfig",
+    "ResNet50",
     "gpt_forward",
     "gpt_init",
     "gpt_param_axes",
-    "ResNet50",
+    "llama_forward",
+    "llama_init",
+    "llama_loss",
+    "llama_param_axes",
     "resnet_init",
 ]

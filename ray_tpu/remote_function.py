@@ -28,6 +28,9 @@ class RemoteFunction:
         if num_tpus:
             self._resources["TPU"] = float(num_tpus)
         self._scheduling_strategy = scheduling_strategy
+        from ray_tpu._private.runtime_env import validate_runtime_env
+
+        validate_runtime_env(runtime_env)
         self._runtime_env = runtime_env
 
     def __call__(self, *args, **kwargs):
@@ -48,6 +51,9 @@ class RemoteFunction:
         if "scheduling_strategy" in opts:
             clone._scheduling_strategy = opts["scheduling_strategy"]
         if "runtime_env" in opts:
+            from ray_tpu._private.runtime_env import validate_runtime_env
+
+            validate_runtime_env(opts["runtime_env"])
             clone._runtime_env = opts["runtime_env"]
         res = dict(clone._resources)
         if "num_cpus" in opts:

@@ -1,0 +1,125 @@
+"""CLI entry points: status / list / summary / timeline / jobs / bench.
+
+Equivalent of the reference's CLI surface
+(reference: python/ray/scripts/scripts.py `ray start|status|...`:548,1259;
+state CLI python/ray/experimental/state/state_cli.py `ray list|summary`;
+job CLI dashboard/modules/job/cli.py; `ray microbenchmark`
+python/ray/_private/ray_perf.py). Usage:
+
+    python -m ray_tpu.scripts.cli status --address <gcs>
+    python -m ray_tpu.scripts.cli list tasks|actors|nodes --address <gcs>
+    python -m ray_tpu.scripts.cli summary --address <gcs>
+    python -m ray_tpu.scripts.cli timeline out.json --address <gcs>
+    python -m ray_tpu.scripts.cli microbenchmark
+    python -m ray_tpu.scripts.cli jobs submit|status|logs|list ...
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(address: str | None):
+    import ray_tpu
+
+    if address:
+        ray_tpu.init(address=address)
+    elif not ray_tpu.is_initialized():
+        raise SystemExit("--address required (no local cluster in this process)")
+
+
+def cmd_status(args) -> None:
+    _connect(args.address)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.summary(), indent=2, default=str))
+
+
+def cmd_list(args) -> None:
+    _connect(args.address)
+    from ray_tpu.util import state
+
+    kind = args.kind
+    rows = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+    }[kind]()
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args) -> None:
+    _connect(args.address)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.summarize_tasks(), indent=2, default=str))
+
+
+def cmd_timeline(args) -> None:
+    _connect(args.address)
+    from ray_tpu.util import state
+
+    state.timeline(args.output)
+    print(f"wrote chrome trace to {args.output} (open in chrome://tracing)")
+
+
+def cmd_microbenchmark(args) -> None:
+    from ray_tpu._private.ray_perf import main as perf_main
+
+    perf_main()
+
+
+def cmd_jobs(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.dashboard)
+    if args.jobs_cmd == "submit":
+        print(client.submit_job(entrypoint=args.entrypoint))
+    elif args.jobs_cmd == "status":
+        print(json.dumps(client.get_job_info(args.job_id), indent=2))
+    elif args.jobs_cmd == "logs":
+        print(client.get_job_logs(args.job_id))
+    elif args.jobs_cmd == "stop":
+        print(client.stop_job(args.job_id))
+    elif args.jobs_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def with_address(sp):
+        sp.add_argument("--address", help="GCS address host:port")
+        return sp
+
+    with_address(sub.add_parser("status")).set_defaults(fn=cmd_status)
+    lp = with_address(sub.add_parser("list"))
+    lp.add_argument("kind", choices=["tasks", "actors", "nodes"])
+    lp.set_defaults(fn=cmd_list)
+    with_address(sub.add_parser("summary")).set_defaults(fn=cmd_summary)
+    tp = with_address(sub.add_parser("timeline"))
+    tp.add_argument("output")
+    tp.set_defaults(fn=cmd_timeline)
+    sub.add_parser("microbenchmark").set_defaults(fn=cmd_microbenchmark)
+    jp = sub.add_parser("jobs")
+    jp.add_argument("--dashboard", default="http://127.0.0.1:8265")
+    jsub = jp.add_subparsers(dest="jobs_cmd", required=True)
+    sp = jsub.add_parser("submit")
+    sp.add_argument("entrypoint")
+    for name in ("status", "logs", "stop"):
+        x = jsub.add_parser(name)
+        x.add_argument("job_id")
+    jsub.add_parser("list")
+    jp.set_defaults(fn=cmd_jobs)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve.batching import batch, pad_to_bucket
+from ray_tpu.serve.multiplex import multiplexed
 from ray_tpu.serve.config import (
     AutoscalingConfig,
     BatchConfig,
@@ -39,6 +40,7 @@ __all__ = [
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "multiplexed",
     "pad_to_bucket",
     "run",
     "shutdown",

@@ -1,0 +1,64 @@
+"""Model multiplexing: many models per replica with LRU residency.
+
+Equivalent of the reference's serve multiplexing
+(reference: python/ray/serve/multiplex.py — @serve.multiplexed loader with
+max_num_models_per_replica LRU). TPU note: evicting a model frees its HBM
+only once all device buffers are dropped, so the loader should return
+device arrays owned solely by the cache entry.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable
+
+
+class _MultiplexedLoader:
+    def __init__(self, loader: Callable[[str], Any], max_num_models: int):
+        self._loader = loader
+        self._max = max_num_models
+        self._lock = threading.Lock()
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+
+    def __call__(self, model_id: str) -> Any:
+        with self._lock:
+            model = self._models.get(model_id)
+            if model is not None:
+                self._models.move_to_end(model_id)
+                return model
+        # load OUTSIDE the lock (loads are slow); racing loads of the same
+        # id resolve to whichever lands last — loads must be idempotent
+        model = self._loader(model_id)
+        with self._lock:
+            self._models[model_id] = model
+            while len(self._models) > self._max:
+                old_id, old = self._models.popitem(last=False)
+                unload = getattr(old, "unload", None)
+                if callable(unload):
+                    unload()
+        return model
+
+    @property
+    def resident_models(self) -> list[str]:
+        with self._lock:
+            return list(self._models)
+
+
+def multiplexed(
+    _loader: Callable | None = None, *, max_num_models_per_replica: int = 3
+):
+    """Wrap a model-loading function with per-replica LRU residency:
+
+        @serve.deployment
+        class M:
+            def __init__(self):
+                self.get_model = serve.multiplexed(
+                    load_model, max_num_models_per_replica=3)
+            def __call__(self, req):
+                return self.get_model(req["model_id"]).predict(req["x"])
+    """
+
+    def wrap(loader):
+        return _MultiplexedLoader(loader, max_num_models_per_replica)
+
+    return wrap if _loader is None else wrap(_loader)

@@ -49,9 +49,21 @@ class ActorPool:
         """Next result in SUBMISSION order."""
         if self._next_return_index >= self._next_task_index:
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(self._next_return_index)
+        # peek — only consume the slot once the result actually resolved,
+        # so a timeout is retryable and never skips/leaks a result
+        ref = self._index_to_future[self._next_return_index]
+        try:
+            out = ray_tpu.get(ref, timeout=timeout)
+        except ray_tpu.exceptions.GetTimeoutError:
+            raise
+        except Exception:
+            # the task FINISHED (with an error): the actor is free again
+            del self._index_to_future[self._next_return_index]
+            self._next_return_index += 1
+            self._return_actor(ref)
+            raise
+        del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
-        out = ray_tpu.get(ref, timeout=timeout)
         self._return_actor(ref)
         return out
 

@@ -1,0 +1,102 @@
+"""multiprocessing.Pool-compatible shim over tasks.
+
+Equivalent of the reference's ray.util.multiprocessing
+(reference: python/ray/util/multiprocessing/pool.py — drop-in Pool whose
+workers are cluster tasks, so a Pool program scales past one host without
+code changes).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: list, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: float | None = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(
+            self._refs, num_returns=len(self._refs), timeout=0
+        )
+        return len(ready) == len(self._refs)
+
+    def wait(self, timeout: float | None = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+
+class Pool:
+    """Process-pool API; each apply/map item is a cluster task."""
+
+    def __init__(self, processes: int | None = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes  # advisory: tasks schedule on CPU slots
+        self._closed = False
+
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def apply_async(self, func: Callable, args=(), kwds=None) -> AsyncResult:
+        self._check()
+        remote_fn = ray_tpu.remote(func)
+        return AsyncResult([remote_fn.remote(*args, **(kwds or {}))], single=True)
+
+    def apply(self, func: Callable, args=(), kwds=None):
+        return self.apply_async(func, args, kwds).get(timeout=None)
+
+    def map_async(self, func: Callable, iterable: Iterable) -> AsyncResult:
+        self._check()
+        remote_fn = ray_tpu.remote(func)
+        return AsyncResult([remote_fn.remote(x) for x in iterable], single=False)
+
+    def map(self, func: Callable, iterable: Iterable) -> list:
+        return self.map_async(func, iterable).get(timeout=None)
+
+    def imap(self, func: Callable, iterable: Iterable):
+        self._check()
+        remote_fn = ray_tpu.remote(func)
+        refs = [remote_fn.remote(x) for x in iterable]
+        for r in refs:
+            yield ray_tpu.get(r, timeout=None)
+
+    def imap_unordered(self, func: Callable, iterable: Iterable):
+        self._check()
+        remote_fn = ray_tpu.remote(func)
+        pending = [remote_fn.remote(x) for x in iterable]
+        while pending:
+            # wait() may return MORE than num_returns ready refs in one
+            # scan pass — yield every one, or they'd be silently dropped
+            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=None)
+            for r in ready:
+                yield ray_tpu.get(r, timeout=60)
+
+    def starmap(self, func: Callable, iterable: Iterable) -> list:
+        self._check()
+        remote_fn = ray_tpu.remote(func)
+        return ray_tpu.get(
+            [remote_fn.remote(*args) for args in iterable], timeout=None
+        )
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

@@ -1,0 +1,79 @@
+"""CLI, multiprocessing Pool shim, serve multiplexing
+(model: reference scripts/state CLI tests; util/multiprocessing tests;
+serve multiplex tests)."""
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+
+def test_cli_status_list_summary(ray_start, capsys, tmp_path):
+    rt = ray_start
+    from ray_tpu.scripts.cli import main
+
+    @rt.remote
+    def tick():
+        return 1
+
+    rt.get([tick.remote() for _ in range(2)], timeout=120)
+    time.sleep(1.0)
+
+    main(["status"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["nodes"]["alive"] == 1
+
+    main(["list", "tasks"])
+    rows = json.loads(capsys.readouterr().out)
+    assert any(r["name"] == "tick" for r in rows)
+
+    main(["summary"])
+    summ = json.loads(capsys.readouterr().out)
+    assert summ["tick"]["count"] == 2
+
+    trace = tmp_path / "t.json"
+    main(["timeline", str(trace)])
+    capsys.readouterr()
+    assert trace.exists()
+
+
+def test_multiprocessing_pool(ray_start):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def sq(x):
+        return x * x
+
+    with Pool() as p:
+        assert p.map(sq, range(6)) == [0, 1, 4, 9, 16, 25]
+        assert p.apply(sq, (7,)) == 49
+        ar = p.apply_async(sq, (8,))
+        assert ar.get(timeout=120) == 64
+        assert sorted(p.imap_unordered(sq, range(4))) == [0, 1, 4, 9]
+        assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_serve_multiplexed_lru():
+    from ray_tpu.serve.multiplex import multiplexed
+
+    loads, unloads = [], []
+
+    class FakeModel:
+        def __init__(self, mid):
+            self.mid = mid
+
+        def unload(self):
+            unloads.append(self.mid)
+
+    @multiplexed(max_num_models_per_replica=2)
+    def get_model(model_id: str):
+        loads.append(model_id)
+        return FakeModel(model_id)
+
+    assert get_model("a").mid == "a"
+    assert get_model("b").mid == "b"
+    assert get_model("a").mid == "a"  # cache hit, refreshes LRU order
+    assert loads == ["a", "b"]
+    get_model("c")  # evicts b (least recently used)
+    assert unloads == ["b"]
+    assert sorted(get_model.resident_models) == ["a", "c"]

@@ -1,0 +1,116 @@
+"""LLaMA family: GQA attention, SwiGLU, MoE variant, mesh sharding
+(model family coverage; test approach mirrors tests/test_models.py)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+def test_llama_forward_shapes_and_dtype(jax_cpu):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig, llama_forward, llama_init
+
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = jax.jit(lambda p, t: llama_forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_llama_gqa_head_validation():
+    from ray_tpu.models.llama import LlamaConfig
+
+    with pytest.raises(ValueError):
+        LlamaConfig(n_head=4, n_kv_head=3)
+
+
+def test_llama_overfits_tiny_batch(jax_cpu):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
+    cfg = LlamaConfig.tiny(vocab_size=64)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 64)
+    batch = {"tokens": tokens}
+
+    @jax.jit
+    def step(params, opt):
+        loss, grads = jax.value_and_grad(llama_loss)(params, batch, cfg)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    first = None
+    for i in range(40):
+        params, opt, loss = step(params, opt)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.5, (first, float(loss))
+
+
+def test_llama_moe_variant_trains(jax_cpu):
+    import jax
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
+    cfg = LlamaConfig.tiny_moe(vocab_size=64)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 64)
+    batch = {"tokens": tokens}
+
+    @jax.jit
+    def step(params, opt):
+        loss, grads = jax.value_and_grad(llama_loss)(params, batch, cfg)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    losses = []
+    for _ in range(30):
+        params, opt, loss = step(params, opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    # router grads must flow (aux loss wired through the scan)
+    assert np.isfinite(losses).all()
+
+
+def test_llama_sharded_matches_single_device(jax_cpu):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from ray_tpu.models.llama import (
+        LlamaConfig, llama_init, llama_loss, llama_param_axes,
+    )
+    from ray_tpu.parallel import (
+        MeshSpec, ShardingRules, build_mesh, shard_params,
+    )
+    from ray_tpu.parallel.sharding import shard_batch_spec
+
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, 128)
+    batch = {"tokens": tokens}
+    ref = float(jax.jit(lambda p, b: llama_loss(p, b, cfg))(params, batch))
+
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    rules = ShardingRules()
+    sp = shard_params(params, llama_param_axes(cfg), mesh, rules)
+    sb = {
+        "tokens": jax.device_put(
+            tokens, NamedSharding(mesh, shard_batch_spec(rules))
+        )
+    }
+    out = float(
+        jax.jit(lambda p, b: llama_loss(p, b, cfg, rules=rules, mesh=mesh))(sp, sb)
+    )
+    assert abs(out - ref) / abs(ref) < 2e-2, (out, ref)
