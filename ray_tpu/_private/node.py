@@ -75,6 +75,11 @@ def start_head(
     store_proc = start_store(
         store_socket, object_store_memory or cfg.object_store_memory_bytes
     )
+    # build+load the native scheduling core NOW so the first dispatch never
+    # stalls on a synchronous g++ compile
+    from ray_tpu._private import scheduler as _sched
+
+    _sched._load_native()
 
     gcs = GcsService()
     gcs_address = gcs.start()

@@ -2,3 +2,7 @@ from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
 __all__ = ["DQN", "DQNConfig", "PPO", "PPOConfig"]
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+
+__all__ += ["A2C", "A2CConfig", "SAC", "SACConfig"]

@@ -1,0 +1,25 @@
+"""A2C — synchronous advantage actor-critic.
+
+Equivalent of the reference's A2C (reference: rllib/algorithms/a2c/a2c.py —
+one synchronous gradient step per rollout batch; deprecated upstream in
+favor of PPO but part of the algorithm surface). Implemented as PPO with a
+single whole-batch update: on the first (only) pass the importance ratio is
+exactly 1, so the clipped surrogate reduces to the vanilla policy gradient
+-logp * advantage.
+"""
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+
+class A2CConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_epochs = 1
+        self.minibatch_size = 1 << 30  # whole batch, clamped per rollout
+        self.clip_param = 1e9  # never clips at ratio == 1
+        self.algo_class = A2C
+
+
+class A2C(PPO):
+    pass

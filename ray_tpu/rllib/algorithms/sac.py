@@ -1,0 +1,197 @@
+"""SAC — soft actor-critic (discrete-action variant).
+
+Equivalent of the reference's SAC (reference: rllib/algorithms/sac/sac.py,
+losses in sac/sac_torch_policy.py; discrete support per the public
+SAC-Discrete formulation). Off-policy: replay buffer, twin soft Q networks
+with polyak targets, entropy-regularized policy, optional automatic
+temperature tuning toward a target entropy.
+
+One Learner/optimizer over {pi, q1, q2, log_alpha}: the loss terms isolate
+their gradients with stop_gradient, so a single optax chain updates all
+groups in one jitted step (TPU-friendly — one compiled program per update).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import ActorCriticModule, QModule, _mlp_jax
+
+
+class SACModule:
+    """Policy + twin Q over the same obs space (discrete actions)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden=(64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.pi = ActorCriticModule(obs_dim, num_actions, hidden)
+        self.q = QModule(obs_dim, num_actions, hidden)
+
+    def init(self, seed: int = 0) -> dict:
+        return {
+            "pi": self.pi.init(seed)["pi"],
+            "q1": self.q.init(seed + 1)["q"],
+            "q2": self.q.init(seed + 2)["q"],
+            # start cool (alpha = 0.1): alpha = 1 lets the entropy bonus
+            # drown small task rewards before temperature tuning catches up
+            "log_alpha": np.float32(np.log(0.1)),
+        }
+
+    # numpy rollout path: sample from the softmax policy
+    def sample_actions_np(self, params, obs, rng):
+        logits = ActorCriticModule._mlp_np(params["pi"], obs)
+        z = logits - logits.max(axis=-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=-1, keepdims=True)
+        cum = np.cumsum(p, axis=-1)
+        r = rng.uniform(size=(len(obs), 1))
+        # float32 cumsum can top out below 1.0 — clamp so r in (cum[-1], 1)
+        # never yields the out-of-range index num_actions
+        actions = np.minimum(
+            (cum < r).sum(axis=-1), self.num_actions - 1
+        ).astype(np.int32)
+        return actions
+
+    def forward_np(self, params, obs):
+        # epsilon_greedy runner mode calls this; SAC uses its own sampling
+        return ActorCriticModule._mlp_np(params["pi"], obs)
+
+
+def sac_loss(module, params, batch, config):
+    import jax
+    import jax.numpy as jnp
+
+    alpha = jnp.exp(params["log_alpha"])
+    gamma = config["gamma"]
+    target_entropy = config["target_entropy"]
+
+    def policy_dist(pi_params, obs):
+        logits = _mlp_jax(pi_params, obs)
+        logp = jax.nn.log_softmax(logits)
+        return jnp.exp(logp), logp
+
+    # --- Q losses (TD toward soft target) ---
+    probs_next, logp_next = policy_dist(params["pi"], batch["next_obs"])
+    q1_t = _mlp_jax(batch["target_q1"], batch["next_obs"])
+    q2_t = _mlp_jax(batch["target_q2"], batch["next_obs"])
+    q_t = jnp.minimum(q1_t, q2_t)
+    # exact expectation over discrete actions
+    v_next = jnp.sum(
+        probs_next * (q_t - jax.lax.stop_gradient(alpha) * logp_next), axis=-1
+    )
+    not_term = 1.0 - batch["terminateds"].astype(jnp.float32)
+    target = jax.lax.stop_gradient(batch["rewards"] + gamma * not_term * v_next)
+
+    q1 = _mlp_jax(params["q1"], batch["obs"])
+    q2 = _mlp_jax(params["q2"], batch["obs"])
+    a = batch["actions"][:, None]
+    q1_a = jnp.take_along_axis(q1, a, axis=-1)[:, 0]
+    q2_a = jnp.take_along_axis(q2, a, axis=-1)[:, 0]
+    q_loss = jnp.mean(jnp.square(q1_a - target)) + jnp.mean(
+        jnp.square(q2_a - target)
+    )
+
+    # --- policy loss: E_a[alpha*logp - minQ] with Q frozen ---
+    probs, logp = policy_dist(params["pi"], batch["obs"])
+    q_min = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+    pi_loss = jnp.mean(
+        jnp.sum(probs * (jax.lax.stop_gradient(alpha) * logp - q_min), axis=-1)
+    )
+
+    # --- temperature loss toward target entropy ---
+    entropy = -jnp.sum(jax.lax.stop_gradient(probs * logp), axis=-1)
+    alpha_loss = jnp.mean(alpha * (entropy - target_entropy))
+
+    total = q_loss + pi_loss + config["alpha_lr_scale"] * alpha_loss
+    return total, {
+        "q_loss": q_loss,
+        "pi_loss": pi_loss,
+        "alpha": alpha,
+        "entropy_mean": jnp.mean(entropy),
+    }
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.updates_per_iteration = 32
+        self.tau = 0.01  # polyak factor for target Q nets
+        self.target_entropy_scale = 0.3  # fraction of max entropy ln(A)
+        self.alpha_lr_scale = 1.0
+        self.lr = 3e-4
+        self.algo_class = SAC
+
+
+class SAC(Algorithm):
+    runner_mode = "softmax"  # stochastic policy is the exploration
+
+    def _runner_factory(self):
+        hidden = tuple(self.config.hidden)
+        return lambda obs_dim, n_act: SACModule(obs_dim, n_act, hidden)
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        import math
+
+        module = SACModule(self.obs_dim, self.num_actions, cfg.hidden)
+        self.learner = Learner(
+            module,
+            sac_loss,
+            config={
+                "gamma": cfg.gamma,
+                "target_entropy": cfg.target_entropy_scale
+                * math.log(self.num_actions),
+                "alpha_lr_scale": cfg.alpha_lr_scale,
+            },
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim, seed=cfg.seed)
+        w = self.learner.get_weights_np()
+        self._target_q1 = w["q1"]
+        self._target_q2 = w["q2"]
+        self._broadcast_weights(w, epsilon=0.0)  # stochastic policy explores
+
+    def _polyak(self) -> None:
+        import jax
+
+        tau = self.config.tau
+        w = self.learner.get_weights_np()
+        self._target_q1 = jax.tree_util.tree_map(
+            lambda t, o: (1 - tau) * t + tau * o, self._target_q1, w["q1"]
+        )
+        self._target_q2 = jax.tree_util.tree_map(
+            lambda t, o: (1 - tau) * t + tau * o, self._target_q2, w["q2"]
+        )
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        for b in self._sample_all():
+            T, E = b["rewards"].shape
+            self.buffer.add_batch(
+                b["obs"].reshape(T * E, -1),
+                b["actions"].reshape(-1),
+                b["rewards"].reshape(-1),
+                b["next_obs"].reshape(T * E, -1),
+                b["terminateds"].reshape(-1),
+            )
+        metrics_acc: dict[str, list[float]] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                mb = self.buffer.sample(cfg.minibatch_size)
+                mb["target_q1"] = self._target_q1
+                mb["target_q2"] = self._target_q2
+                m = self.learner.update(mb)
+                self._polyak()
+                for k, v in m.items():
+                    metrics_acc.setdefault(k, []).append(v)
+        self._broadcast_weights(self.learner.get_weights_np(), epsilon=0.0)
+        out = {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+        out["replay_size"] = len(self.buffer)
+        return out

@@ -23,7 +23,10 @@ class EnvRunner:
         num_envs: int = 1,
         rollout_length: int = 64,
         seed: int = 0,
-        mode: str = "actor_critic",  # actor_critic | epsilon_greedy
+        # actor_critic: sample policy + record logp/values (PPO family)
+        # epsilon_greedy: argmax Q with annealed exploration (DQN family)
+        # softmax: sample the module's stochastic policy (SAC family)
+        mode: str = "actor_critic",
     ):
         from ray_tpu.rllib.env import VectorEnv
 
@@ -77,6 +80,10 @@ class EnvRunner:
                 )
                 batch["logp"][t] = logp
                 batch["values"][t] = values
+            elif self.mode == "softmax":
+                actions = self.module.sample_actions_np(
+                    self._params, obs, self._rng
+                )
             else:
                 q = self.module.forward_np(self._params, obs)
                 greedy = np.argmax(q, axis=-1)

@@ -26,16 +26,26 @@ class _MultiplexedLoader:
             if model is not None:
                 self._models.move_to_end(model_id)
                 return model
-        # load OUTSIDE the lock (loads are slow); racing loads of the same
-        # id resolve to whichever lands last — loads must be idempotent
+        # load OUTSIDE the lock (loads are slow)
         model = self._loader(model_id)
+        to_unload = []
         with self._lock:
-            self._models[model_id] = model
-            while len(self._models) > self._max:
-                old_id, old = self._models.popitem(last=False)
-                unload = getattr(old, "unload", None)
-                if callable(unload):
-                    unload()
+            existing = self._models.get(model_id)
+            if existing is not None:
+                # lost a racing load: keep the cached one, drop our copy so
+                # its device buffers (HBM) free promptly
+                self._models.move_to_end(model_id)
+                to_unload.append(model)
+                model = existing
+            else:
+                self._models[model_id] = model
+                while len(self._models) > self._max:
+                    _, old = self._models.popitem(last=False)
+                    to_unload.append(old)
+        for m in to_unload:
+            unload = getattr(m, "unload", None)
+            if callable(unload):
+                unload()
         return model
 
     @property

@@ -76,7 +76,7 @@ class Pool:
             # scan pass — yield every one, or they'd be silently dropped
             ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=None)
             for r in ready:
-                yield ray_tpu.get(r, timeout=60)
+                yield ray_tpu.get(r, timeout=None)
 
     def starmap(self, func: Callable, iterable: Iterable) -> list:
         self._check()

@@ -102,3 +102,52 @@ def test_slice_bundle_lands_on_one_ici_domain(ray_cluster):
         gcs.nodes[a["node_id"]]["labels"]["ici-domain"] for a in alloc
     }
     assert len(domains) == 1
+
+
+def test_native_scheduler_matches_python_oracle():
+    """The C++ pick_node core must agree with the Python policy on random
+    clusters (cpp/sched.cpp vs scheduler.pick_node fallback)."""
+    import random as pyrandom
+
+    from ray_tpu._private import scheduler as sched
+
+    lib = sched._load_native()
+    assert lib is not None, "native scheduling core failed to build"
+    rng = pyrandom.Random(0)
+    for trial in range(300):
+        n_nodes = rng.randint(1, 6)
+        nodes = {}
+        for i in range(n_nodes):
+            total = {"CPU": float(rng.randint(1, 8)), "TPU": float(rng.choice([0, 0, 4]))}
+            avail = {k: rng.uniform(0, v) if rng.random() < 0.8 else v
+                     for k, v in total.items()}
+            nodes[bytes([i])] = {
+                "resources": total,
+                "available": avail,
+                "alive": rng.random() > 0.1,
+            }
+        demand = {"CPU": float(rng.randint(1, 4))}
+        if rng.random() < 0.3:
+            demand["TPU"] = float(rng.choice([1, 4]))
+        strategy = rng.choice(["default", "spread"])
+        local = rng.choice(list(nodes)) if rng.random() < 0.5 else None
+
+        native = sched._pick_node_native(demand, nodes, strategy, local)
+
+        def frac(nid):
+            n = nodes[nid]
+            return n["available"].get("CPU", 0.0) / (n["resources"].get("CPU", 1.0) or 1.0)
+
+        feasible = [nid for nid, n in nodes.items()
+                    if n["alive"] and sched.fits(demand, n["available"])]
+        if not feasible:
+            assert native is None
+            continue
+        assert native in feasible
+        if strategy == "default":
+            if local in feasible:
+                assert native == local
+            else:
+                assert frac(native) == min(frac(f) for f in feasible)
+        else:
+            assert frac(native) == max(frac(f) for f in feasible)

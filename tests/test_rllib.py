@@ -108,6 +108,48 @@ def test_dqn_learns_corridor(jax_cpu):
     assert result["episode_return_mean"] >= 0.7, result
 
 
+def test_a2c_learns_corridor(jax_cpu):
+    from ray_tpu.rllib.algorithms.a2c import A2CConfig
+
+    cfg = (
+        A2CConfig()
+        .environment("Corridor")
+        .env_runners(num_env_runners=0, num_envs_per_runner=8, rollout_length=32)
+        .training(lr=2e-3, entropy_coeff=0.02)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    result = {}
+    for _ in range(40):
+        result = algo.train()
+        if result["episode_return_mean"] >= 0.7:
+            break
+    assert result["episode_return_mean"] >= 0.7, result
+
+
+def test_sac_learns_corridor(jax_cpu):
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+
+    cfg = (
+        SACConfig()
+        .environment("Corridor")
+        .env_runners(num_env_runners=0, num_envs_per_runner=4, rollout_length=32)
+        .training(
+            lr=3e-3, minibatch_size=64, learning_starts=200,
+            updates_per_iteration=48,
+        )
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    result = {}
+    for _ in range(40):
+        result = algo.train()
+        if result["episode_return_mean"] >= 0.7:
+            break
+    assert result["episode_return_mean"] >= 0.7, result
+    assert result["alpha"] > 0  # temperature stayed positive
+
+
 def test_ppo_remote_env_runners(ray_start, jax_cpu):
     from ray_tpu.rllib import PPOConfig
 
