@@ -1,28 +1,125 @@
 """Benchmark: ResNet-50 training throughput on TPU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu", ...}.
 
 Baseline: the reference's published TorchTrainer ResNet image-training
 throughput on one GPU — 40.7 images/sec (BASELINE.md; reference:
 doc/source/train/benchmarks.rst:33-37, 1x g3.8xlarge, 1 worker). Ours is
 the same model family (ResNet-50, bf16) trained on one TPU chip with a
 jitted step; vs_baseline = value / 40.7.
+
+Hardening (a backend stall must never produce zero output):
+- A watchdog thread holds the best result measured so far; when the
+  wall-clock budget expires it prints that JSON line and `os._exit`s —
+  a hung XLA call cannot be interrupted any other way.
+- A tiny probe run executes FIRST so a real number exists within ~a
+  minute even if the full-size run never completes.
+- The timed loop is chunked; each completed chunk updates the watchdog's
+  partial result, so a mid-run stall still reports measured throughput.
+- Persistent compilation cache so a rerun skips the ~compile cost.
 """
 from __future__ import annotations
 
 import json
+import os
+import threading
 import time
+
+BASELINE_IMG_PER_SEC = 40.7  # reference 1-GPU TorchTrainer (BASELINE.md)
+
+# ResNet-50 @224: ~4.09 GFLOPs forward per image; train step (fwd+bwd) ~3x.
+RESNET50_TRAIN_GFLOPS_PER_IMG_224 = 3.0 * 4.09
+
+# Known per-chip peak bf16 TFLOP/s by device_kind substring.
+_CHIP_PEAK_TFLOPS = [
+    ("v6", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),
+    ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+_state_lock = threading.Lock()
+_best_result: dict | None = None  # watchdog prints this on budget expiry
+_printed = False  # exactly ONE JSON line may reach stdout
+
+
+def _publish(result: dict) -> None:
+    global _best_result
+    with _state_lock:
+        _best_result = result
+
+
+def _claim_print() -> bool:
+    global _printed
+    with _state_lock:
+        if _printed:
+            return False
+        _printed = True
+        return True
+
+
+def _watchdog(budget_s: float) -> None:
+    time.sleep(budget_s)
+    with _state_lock:
+        result = _best_result
+    if not _claim_print():
+        return
+    if result is None:
+        result = {
+            "metric": "resnet50_train_images_per_sec_per_chip_timeout",
+            "value": 0.0,
+            "unit": "images/sec",
+            "vs_baseline": 0.0,
+            "error": "backend stall before any measurement completed",
+        }
+    else:
+        result = dict(result)
+        result["partial"] = True
+    print(json.dumps(result), flush=True)
+    os._exit(0)
+
+
+def _chip_peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in _CHIP_PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    if device.platform == "cpu":
+        return 0.5  # nominal; MFU on CPU is not meaningful
+    return 275.0  # assume v4-class if unknown
+
+
+def _make_result(images_per_sec: float, platform: str, image_size: int,
+                 peak_tflops: float, tag: str = "") -> dict:
+    # Scale FLOPs quadratically with resolution relative to 224 (convs dominate).
+    gflops_img = RESNET50_TRAIN_GFLOPS_PER_IMG_224 * (image_size / 224.0) ** 2
+    achieved_tflops = images_per_sec * gflops_img / 1e3
+    return {
+        "metric": f"resnet50_train_images_per_sec_per_chip_{platform}{tag}",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / BASELINE_IMG_PER_SEC, 2),
+        "mfu": round(achieved_tflops / peak_tflops, 4) if peak_tflops else 0.0,
+        "achieved_tflops": round(achieved_tflops, 1),
+        "chip_peak_tflops": peak_tflops,
+    }
 
 
 def run_bench(batch_size: int = 256, steps: int = 60, warmup: int = 5,
-              image_size: int = 224) -> dict:
+              image_size: int = 224, tag: str = "",
+              chunk: int = 10) -> dict:
     import jax
     import jax.numpy as jnp
     import optax
 
     from ray_tpu.models.resnet import ResNet50, resnet_init, resnet_loss
 
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    platform = dev.platform
+    peak = _chip_peak_tflops(dev)
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     params, batch_stats = resnet_init(jax.random.PRNGKey(0), model, image_size)
 
@@ -57,34 +154,83 @@ def run_bench(batch_size: int = 256, steps: int = 60, warmup: int = 5,
     # materializing a value forces the enqueued computation chain.
     float(loss)
 
+    done = 0
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, batch
-        )
-    float(loss)  # forces the whole step chain via dataflow dependency
+    while done < steps:
+        n = min(chunk, steps - done)
+        for _ in range(n):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, batch
+            )
+        float(loss)  # forces the chunk's step chain via dataflow dependency
+        done += n
+        dt = time.perf_counter() - t0
+        _publish(_make_result(batch_size * done / dt, platform, image_size,
+                              peak, tag))
     dt = time.perf_counter() - t0
-
-    images_per_sec = batch_size * steps / dt
-    baseline = 40.7  # images/sec, reference 1-GPU TorchTrainer (BASELINE.md)
-    return {
-        "metric": f"resnet50_train_images_per_sec_per_chip_{platform}",
-        "value": round(images_per_sec, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / baseline, 2),
-    }
+    return _make_result(batch_size * steps / dt, platform, image_size, peak, tag)
 
 
-if __name__ == "__main__":
+def main() -> None:
     import sys
+
+    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
+    threading.Thread(target=_watchdog, args=(budget,), daemon=True).start()
+
+    # The axon sitecustomize overrides jax_platforms at interpreter start, so
+    # a JAX_PLATFORMS=cpu env request must be re-asserted in-process.
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    cache_dir = os.environ.get(
+        "BENCH_COMPILE_CACHE", os.path.expanduser("~/.cache/ray_tpu_bench_xla")
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization; never fail the bench over it
 
     kwargs = {}
     if len(sys.argv) > 1:
         kwargs["batch_size"] = int(sys.argv[1])
+
+    # Tiny probe first: lands a real measured number within ~a minute so a
+    # stall during the full-size run can still report throughput.
+    try:
+        probe = run_bench(batch_size=32, steps=6, warmup=2, image_size=96,
+                          tag="_probe", chunk=3)
+        _publish(probe)
+    except Exception:
+        probe = None
+
     try:
         result = run_bench(**kwargs)
-    except Exception:
-        # smaller fallback (memory-constrained or CPU-only environments)
-        result = run_bench(batch_size=32, steps=5, warmup=2, image_size=96)
-        result["metric"] += "_fallback"
-    print(json.dumps(result))
+    except Exception as e:
+        if probe is not None:
+            result = probe
+        else:
+            try:
+                # smallest fallback (memory-constrained or CPU-only envs)
+                result = run_bench(batch_size=32, steps=5, warmup=2,
+                                   image_size=96, tag="_fallback", chunk=5)
+            except Exception as e2:
+                # even a fast non-stall failure must land a JSON line
+                result = {
+                    "metric": "resnet50_train_images_per_sec_per_chip_error",
+                    "value": 0.0,
+                    "unit": "images/sec",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}; fallback: "
+                             f"{type(e2).__name__}: {e2}"[:500],
+                }
+    if _claim_print():
+        print(json.dumps(result), flush=True)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Content-hash-gated builds of the C++ runtime components.
+
+Artifacts are compiled into ``ray_tpu/cpp/build/`` (never committed) with
+the source digest in the filename, so a checkout can never load a stale or
+foreign binary: a changed source hashes to a new path and rebuilds; the
+mtime of files restored by git is irrelevant.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_cache: dict[str, str] = {}
+
+
+def build_native(
+    src: str,
+    out_name: str,
+    compile_args: list[str],
+    link_args: list[str] | None = None,
+) -> str:
+    """Compile ``src`` with g++ if no artifact for its current content
+    exists; returns the artifact path. Safe under concurrent callers
+    (atomic rename; same digest converges to the same path)."""
+    with _lock:
+        cached = _cache.get(src)
+        if cached and os.path.exists(cached):
+            return cached
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:12]
+        build_dir = os.path.join(os.path.dirname(src), "build")
+        os.makedirs(build_dir, exist_ok=True)
+        out = os.path.join(build_dir, f"{out_name}.{digest}")
+        if not os.path.exists(out):
+            tmp = f"{out}.tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", *compile_args, "-o", tmp, src, *(link_args or [])],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, out)
+        _cache[src] = out
+        return out

@@ -31,22 +31,13 @@ EVICTED = object()
 _CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "cpp")
 
 
-def _binary_path() -> str:
-    return os.path.join(_CPP_DIR, "ray_tpu_store")
+def build_store_binary() -> str:
+    """Compile the store daemon with g++ (content-hash cached)."""
+    from ray_tpu._private.native_build import build_native
 
-
-def build_store_binary(force: bool = False) -> str:
-    """Compile the store daemon with g++ if not already built (cached)."""
     src = os.path.join(_CPP_DIR, "store.cpp")
-    out = _binary_path()
-    if not force and os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
-        return out
-    subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-pthread", "-o", out, src, "-lrt"],
-        check=True,
-        capture_output=True,
-    )
-    return out
+    return build_native(src, "ray_tpu_store",
+                        ["-O2", "-std=c++17", "-pthread"], ["-lrt"])
 
 
 def start_store(socket_path: str, capacity_bytes: int) -> subprocess.Popen:

@@ -211,26 +211,33 @@ class RpcClient:
         address: str,
         notify_handler: Callable[[str, Any], None] | None = None,
         connect_timeout: float = 10.0,
+        auto_reconnect: bool = False,
+        reconnect_window: float = 10.0,
     ):
         host, port = address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)), timeout=connect_timeout)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.address = address
+        self._connect_timeout = connect_timeout
+        self._auto_reconnect = auto_reconnect
+        self._reconnect_window = reconnect_window
         self._send_lock = threading.Lock()
         self._pending: dict[int, Future] = {}
         self._pending_lock = threading.Lock()
         self._msgid = 0
+        self._gen = 0  # connection generation; bumped by reconnect()
         self._notify_handler = notify_handler
         self._closed = threading.Event()
         self._reader = threading.Thread(
-            target=self._read_loop, daemon=True, name=f"rpc-client-{address}"
+            target=self._read_loop, args=(self._sock, 0), daemon=True,
+            name=f"rpc-client-{address}",
         )
         self._reader.start()
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, sock: socket.socket, gen: int) -> None:
         while not self._closed.is_set():
-            msg = _read_msg(self._sock)
+            msg = _read_msg(sock)
             if msg is None:
                 break
             mtype = msg[0]
@@ -253,8 +260,12 @@ class RpcClient:
         # a send after this point can land in the kernel buffer without
         # error and would otherwise pend forever. _dead is set under
         # _pending_lock so a racing call_async either sees the flag or has
-        # its future registered before the sweep below.
+        # its future registered before the sweep below. A reader whose
+        # generation was superseded by reconnect() must NOT run the sweep:
+        # the pending futures now belong to the new connection.
         with self._pending_lock:
+            if gen != self._gen:
+                return
             self._dead = True
             for fut in self._pending.values():
                 if not fut.done():
@@ -290,8 +301,79 @@ class RpcClient:
                     )
         return fut
 
+    def reconnect(self, connect_timeout: float | None = None) -> bool:
+        """Re-establish a lost connection in place (e.g. GCS restart-in-place,
+        reference: raylet reconnect on NotifyGCSRestart). The client object
+        identity is preserved, so holders of this client (task-event buffer,
+        cached peers) heal without re-plumbing. Returns True if a live
+        connection exists afterwards."""
+        with self._send_lock:
+            with self._pending_lock:
+                if self._closed.is_set():
+                    return False
+                if not self._dead:
+                    return True
+            host, port = self.address.rsplit(":", 1)
+            try:
+                sock = socket.create_connection(
+                    (host, int(port)), timeout=connect_timeout or self._connect_timeout
+                )
+            except OSError:
+                return False
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._pending_lock:
+                # close() may have landed after the check above: don't
+                # install a socket/reader on a closed client
+                if self._closed.is_set():
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return False
+                self._gen += 1
+                gen = self._gen
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(
+                            ConnectionError(f"connection to {self.address} lost")
+                        )
+                self._pending.clear()
+                old = self._sock
+                self._sock = sock
+                self._dead = False
+            try:
+                old.close()
+            except OSError:
+                pass
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(sock, gen), daemon=True,
+                name=f"rpc-client-{self.address}",
+            )
+            self._reader.start()
+            return True
+
     def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
-        return self.call_async(method, payload).result(timeout)
+        try:
+            return self.call_async(method, payload).result(timeout)
+        except ConnectionError:
+            if not self._auto_reconnect:
+                raise
+        # Auto-reconnect window: the server may be restarting in place.
+        # Control-plane calls here are idempotent (registers, heartbeats,
+        # gets, event appends), so a retry after reconnect is safe.
+        deadline = time.monotonic() + self._reconnect_window
+        while True:
+            if self.reconnect():
+                try:
+                    return self.call_async(method, payload).result(timeout)
+                except ConnectionError:
+                    pass
+            if self._closed.is_set() or time.monotonic() >= deadline:
+                raise ConnectionError(
+                    f"connection to {self.address} lost (reconnect window expired)"
+                )
+            time.sleep(0.1)
 
     def close(self) -> None:
         self._closed.set()

@@ -13,7 +13,6 @@ from __future__ import annotations
 import ctypes
 import os
 import random
-import subprocess
 import threading
 from typing import Sequence
 
@@ -30,13 +29,13 @@ def _load_native():
         if _native_lib is not None:
             return _native_lib or None
         src = os.path.join(_CPP_DIR, "sched.cpp")
-        out = os.path.join(_CPP_DIR, "libray_tpu_sched.so")
         try:
-            if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-o", out, src],
-                    check=True, capture_output=True,
-                )
+            from ray_tpu._private.native_build import build_native
+
+            # content-hash gate: a stale committed/restored binary can never
+            # be loaded — the artifact path embeds the source digest
+            out = build_native(src, "libray_tpu_sched.so",
+                               ["-O2", "-shared", "-fPIC"])
             lib = ctypes.CDLL(out)
             lib.rt_pick_node.restype = ctypes.c_int
             lib.rt_pick_node.argtypes = [

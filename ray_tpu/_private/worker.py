@@ -60,7 +60,12 @@ class CoreWorker:
         self.worker_id = worker_id or WorkerID.from_random()
         self.task_id = TaskID.for_driver(job_id)  # current task context
         self.store = ObjectStoreClient(store_socket)
-        self.gcs = RpcClient(gcs_address, notify_handler=self._on_notify)
+        # auto_reconnect: the GCS may restart in place (GCS FT) — the raylet
+        # heals its own client in its heartbeat loop; the worker's client
+        # must heal too or actor resolution and task events latch dead
+        self.gcs = RpcClient(
+            gcs_address, notify_handler=self._on_notify, auto_reconnect=True
+        )
         self.raylet = RpcClient(raylet_address, notify_handler=self._on_notify)
         self._put_counter = 0
         self._task_lock = threading.Lock()

@@ -202,15 +202,19 @@ def gpt_loss(
 ) -> jax.Array:
     """Next-token cross-entropy. batch: {"tokens": [B, S+1]} or
     {"inputs": [B,S], "targets": [B,S]}."""
+    mask = batch.get("mask")
     if "tokens" in batch:
         inputs = batch["tokens"][:, :-1]
         targets = batch["tokens"][:, 1:]
+        # a [B, S+1] token-aligned mask must shift with the targets; a
+        # [B, S] mask is already target-aligned
+        if mask is not None and mask.shape[-1] == batch["tokens"].shape[-1]:
+            mask = mask[:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
     logits = gpt_forward(params, inputs, cfg, rules=rules, mesh=mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
     if mask is not None:
         return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1)
     return -jnp.mean(ll)

@@ -255,9 +255,14 @@ def llama_loss(
     rules: ShardingRules | None = None,
     mesh=None,
 ) -> jax.Array:
+    mask = batch.get("mask")
     if "tokens" in batch:
         inputs = batch["tokens"][:, :-1]
         targets = batch["tokens"][:, 1:]
+        # a [B, S+1] token-aligned mask must shift with the targets; a
+        # [B, S] mask is already target-aligned
+        if mask is not None and mask.shape[-1] == batch["tokens"].shape[-1]:
+            mask = mask[:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
     logits, aux = llama_forward(
@@ -265,7 +270,6 @@ def llama_loss(
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
     if mask is not None:
         ce = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1)
     else:

@@ -83,9 +83,11 @@ class ActorPool:
                 if idx == self._next_return_index:
                     self._next_return_index += 1
                 break
-        out = ray_tpu.get(ref)
+        # the task FINISHED (ready): free the actor BEFORE the get, which
+        # re-raises task errors — otherwise a failed task leaks the actor and
+        # map_unordered re-selects the same ready-failed ref forever
         self._return_actor(ref)
-        return out
+        return ray_tpu.get(ref)
 
     def map(self, fn: Callable, values: Iterable) -> Iterable:
         for v in values:
