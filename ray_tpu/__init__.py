@@ -69,9 +69,21 @@ def init(
             nodes = [n for n in probe.call("get_nodes")["nodes"] if n["alive"]]
         finally:
             probe.close()
-        local = [n for n in nodes if n.get("store_socket")]
+        # Attach to a node on THIS host: the driver needs a local raylet and
+        # a local store daemon (reference: the driver always connects to its
+        # node's raylet/plasma over unix sockets). A node is local iff its
+        # store socket path exists here.
+        local = [
+            n
+            for n in nodes
+            if n.get("store_socket") and _os.path.exists(n["store_socket"])
+        ]
         if not local:
-            raise RuntimeError(f"no connectable nodes registered at {address}")
+            raise RuntimeError(
+                f"no cluster node is running on this host (cluster at "
+                f"{address} has {len(nodes)} alive nodes); run "
+                f"`ray_tpu start --address {address}` here first"
+            )
         connect(
             gcs_address=address,
             raylet_address=local[0]["address"],

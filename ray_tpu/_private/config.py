@@ -29,6 +29,7 @@ class Config:
     object_store_full_delay_ms: int = 100
     object_spilling_dir: str = ""  # default under session dir
     min_spilling_size: int = 1 * 1024 * 1024
+    object_pull_chunk_bytes: int = 8 * 1024 * 1024  # inter-node transfer chunk
     # --- raylet ---
     num_workers_soft_limit: int = -1  # default: num_cpus
     # generous: several python workers cold-spawning serially on a loaded

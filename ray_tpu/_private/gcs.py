@@ -48,6 +48,18 @@ class GcsService:
         # pg_id(bytes) -> {bundles, strategy, state, allocations}
         self.placement_groups: dict[bytes, dict] = {}
         self._job_counter = 0
+        # object directory: object_id(bytes) -> {"nodes": set[node_id],
+        # "evicted": bool}. Locations are runtime state fed by store
+        # seal/evict notifications via each raylet; NOT persisted (stores
+        # don't survive a head restart either). Reference: the object
+        # directory role of ownership_based_object_directory.cc:551, here
+        # GCS-resolved (round-3 simplification, owner-resolution later).
+        self.object_dir: dict[bytes, dict] = {}
+        # tombstoned entries age out (health loop) so the directory doesn't
+        # grow with every object ever created; live-location entries are
+        # real state and stay
+        self._dir_tombstone_ts: dict[bytes, float] = {}
+        self._dir_tombstone_ttl_s = 300.0
         # topic -> set of conns
         self._subs: dict[str, set] = defaultdict(set)
         self._raylet_clients: dict[bytes, RpcClient] = {}
@@ -162,6 +174,16 @@ class GcsService:
                     if now - info["last_heartbeat"] > interval * threshold:
                         info["alive"] = False
                         dead.append(node_id)
+                # sweep aged object-directory tombstones (getters that still
+                # care learned "evicted" long ago and reconstructed)
+                cutoff = now - self._dir_tombstone_ttl_s
+                expired = [
+                    oid for oid, ts in self._dir_tombstone_ts.items()
+                    if ts < cutoff
+                ]
+                for oid in expired:
+                    del self._dir_tombstone_ts[oid]
+                    self.object_dir.pop(oid, None)
             for node_id in dead:
                 self._on_node_death(node_id)
 
@@ -276,6 +298,48 @@ class GcsService:
                 for k, v in n.get("available", n["resources"]).items():
                     available[k] += v
         return {"total": dict(total), "available": dict(available)}
+
+    # ---------------- RPC: object directory ----------------
+
+    def rpc_object_location_update(self, conn, msgid, p):
+        """Batched, ORDERED location updates from a raylet's store-event
+        stream. p: {node_id, events: [["s"|"e", oid], ...]} — order matters:
+        evict-then-reseal within one batch must end as present."""
+        nid = p["node_id"]
+        now = time.monotonic()
+        with self._lock:
+            for ev, oid in p["events"]:
+                e = self.object_dir.get(oid)
+                if ev == "s":
+                    if e is None:
+                        e = self.object_dir[oid] = {"nodes": set(), "evicted": False}
+                    e["nodes"].add(nid)
+                    e["evicted"] = False
+                    self._dir_tombstone_ts.pop(oid, None)
+                else:
+                    if e is None:
+                        continue
+                    e["nodes"].discard(nid)
+                    if not e["nodes"]:
+                        e["evicted"] = True  # tombstone: owners reconstruct
+                        self._dir_tombstone_ts[oid] = now
+        return {"ok": True}
+
+    def rpc_get_object_locations(self, conn, msgid, p):
+        oid = p["object_id"]
+        with self._lock:
+            e = self.object_dir.get(oid)
+            if e is None:
+                return {"nodes": [], "evicted": False, "known": False}
+            alive = [
+                {"node_id": nid, "address": self.nodes[nid]["address"]}
+                for nid in e["nodes"]
+                if nid in self.nodes and self.nodes[nid]["alive"]
+            ]
+            # every holder died: the object is lost (reconstructible only
+            # via lineage) — report it as evicted
+            lost = not alive and (e["evicted"] or bool(e["nodes"]))
+            return {"nodes": alive, "evicted": lost, "known": True}
 
     # ---------------- RPC: jobs ----------------
 

@@ -3,17 +3,19 @@
 Equivalent of the reference's node bootstrap
 (reference: python/ray/_private/node.py — Node.start_head_processes:1395
 spawns gcs_server, start_ray_processes:1424 spawns the raylet which embeds
-plasma). Here the store is a real subprocess (C++ daemon); GCS and raylet
-run as threads in the driver process by default — same protocol, fewer
-processes — and the `Cluster` harness stacks extra in-process raylets for
-multi-node tests (reference: python/ray/cluster_utils.py:108).
+plasma). The store is always a real subprocess (C++ daemon), ONE PER NODE;
+GCS and raylet run as threads in the hosting process — same protocol, fewer
+processes. Standalone node processes (`ray_tpu start --head` /
+`--address=<gcs>`) are hosted by _private/node_main.py; the `Cluster`
+harness stacks extra in-process raylets, each with its own store daemon,
+for multi-node tests (reference: python/ray/cluster_utils.py:108).
 """
 from __future__ import annotations
 
 import atexit
 import os
 import tempfile
-import uuid
+from typing import Any
 
 from ray_tpu._private.config import global_config
 from ray_tpu._private.gcs import GcsService
@@ -61,6 +63,26 @@ class NodeHandle:
                 pass
 
 
+def _default_node_resources(
+    num_cpus: float | None,
+    num_tpus: float | None,
+    resources: dict[str, float] | None,
+    labels: dict[str, str] | None,
+) -> tuple[dict[str, float], dict[str, str]]:
+    node_resources = dict(resources or {})
+    node_resources.setdefault(
+        "CPU", float(num_cpus if num_cpus is not None else os.cpu_count() or 1)
+    )
+    node_resources.setdefault(
+        "TPU", float(num_tpus if num_tpus is not None else autodetect_tpu_chips())
+    )
+    node_resources.setdefault("memory", float(2 * 1024**3))
+    node_labels = dict(labels or {})
+    if node_resources["TPU"] > 0:
+        node_labels.setdefault("ici-domain", "slice-0")
+    return node_resources, node_labels
+
+
 def start_head(
     *,
     num_cpus: float | None = None,
@@ -68,6 +90,7 @@ def start_head(
     resources: dict[str, float] | None = None,
     labels: dict[str, str] | None = None,
     object_store_memory: int | None = None,
+    gcs_port: int = 0,
 ) -> NodeHandle:
     cfg = global_config()
     session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
@@ -82,18 +105,11 @@ def start_head(
     _sched._load_native()
 
     gcs = GcsService()
-    gcs_address = gcs.start()
+    gcs_address = gcs.start(port=gcs_port)
 
-    node_resources = dict(resources or {})
-    node_resources.setdefault("CPU", float(num_cpus if num_cpus is not None else os.cpu_count() or 1))
-    node_resources.setdefault(
-        "TPU", float(num_tpus if num_tpus is not None else autodetect_tpu_chips())
+    node_resources, node_labels = _default_node_resources(
+        num_cpus, num_tpus, resources, labels
     )
-    node_resources.setdefault("memory", float(2 * 1024**3))
-    node_labels = dict(labels or {})
-    if node_resources["TPU"] > 0:
-        node_labels.setdefault("ici-domain", "slice-0")
-
     raylet = Raylet(
         NodeID.from_random(), gcs_address, store_socket, node_resources, node_labels
     )
@@ -109,13 +125,48 @@ def start_head(
     return handle
 
 
+def start_worker_node(
+    gcs_address: str,
+    *,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    resources: dict[str, float] | None = None,
+    labels: dict[str, str] | None = None,
+    object_store_memory: int | None = None,
+) -> NodeHandle:
+    """Join an existing cluster as a new node: own store daemon + raylet
+    (reference: `ray start --address=<gcs>`, scripts.py:548 worker path)."""
+    cfg = global_config()
+    session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
+    store_socket = os.path.join(session_dir, "store.sock")
+    store_proc = start_store(
+        store_socket, object_store_memory or cfg.object_store_memory_bytes
+    )
+    node_resources, node_labels = _default_node_resources(
+        num_cpus, num_tpus, resources, labels
+    )
+    raylet = Raylet(
+        NodeID.from_random(), gcs_address, store_socket, node_resources, node_labels
+    )
+    handle = NodeHandle(
+        gcs=None,
+        gcs_address=gcs_address,
+        raylet=raylet,
+        store_proc=store_proc,
+        store_socket=store_socket,
+        session_dir=session_dir,
+    )
+    atexit.register(handle.shutdown)
+    return handle
+
+
 class Cluster:
     """In-process fake multi-node cluster for tests.
 
     Reference: python/ray/cluster_utils.py:108 Cluster — extra raylets in one
-    process against one GCS. All nodes share the single host store (valid:
-    on one physical host the reference's plasma is also per-node but our
-    tests only assert scheduling semantics, not store isolation).
+    process against one GCS. Every node runs its OWN store daemon; objects
+    move between nodes through the raylet pull/push object plane, exactly as
+    they would across physical hosts.
     """
 
     def __init__(self, head_resources: dict[str, float] | None = None):
@@ -127,6 +178,7 @@ class Cluster:
             },
         )
         self.nodes: list[Raylet] = [self.head.raylet]
+        self._store_procs: dict[bytes, Any] = {}
 
     @property
     def gcs_address(self) -> str:
@@ -139,7 +191,9 @@ class Cluster:
         num_tpus: float = 0,
         resources: dict[str, float] | None = None,
         labels: dict[str, str] | None = None,
+        object_store_memory: int | None = None,
     ) -> Raylet:
+        cfg = global_config()
         node_resources = dict(resources or {})
         node_resources["CPU"] = float(num_cpus)
         node_resources["TPU"] = float(num_tpus)
@@ -147,19 +201,32 @@ class Cluster:
         node_labels = dict(labels or {})
         if num_tpus > 0:
             node_labels.setdefault("ici-domain", f"slice-{len(self.nodes)}")
+        store_socket = os.path.join(
+            self.head.session_dir, f"store-{len(self.nodes)}.sock"
+        )
+        store_proc = start_store(
+            store_socket, object_store_memory or cfg.object_store_memory_bytes
+        )
         raylet = Raylet(
             NodeID.from_random(),
             self.head.gcs_address,
-            self.head.store_socket,
+            store_socket,
             node_resources,
             node_labels,
         )
         self.nodes.append(raylet)
+        self._store_procs[raylet.node_id.binary()] = store_proc
         return raylet
 
     def remove_node(self, raylet: Raylet) -> None:
         raylet.stop()
         self.nodes.remove(raylet)
+        proc = self._store_procs.pop(raylet.node_id.binary(), None)
+        if proc is not None:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
         try:
             self.head.gcs.rpc_drain_node(None, 0, {"node_id": raylet.node_id.binary()})
         except Exception:
@@ -171,4 +238,10 @@ class Cluster:
                 raylet.stop()
             except Exception:
                 pass
+        for proc in self._store_procs.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        self._store_procs.clear()
         self.head.shutdown()

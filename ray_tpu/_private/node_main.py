@@ -1,0 +1,94 @@
+"""`ray_tpu start` node process: hosts a full cluster node.
+
+Equivalent of the reference's `ray start` head/worker node processes
+(reference: python/ray/scripts/scripts.py:548 `ray start`, which spawns
+gcs_server + raylet via Node.start_head_processes node.py:1395/1424). One
+OS process per node: the C++ store daemon as a subprocess, GCS (head only)
+and the raylet as threads. Writes a JSON info file so `ray_tpu stop` and
+drivers on the same host can find the node, prints a readiness line, and
+runs until SIGTERM/SIGINT.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def default_info_dir() -> str:
+    return os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu", "nodes")
+
+
+def default_info_path() -> str:
+    """One info file per node process (keyed by pid) — several nodes can
+    coexist on a host and `ray_tpu stop` stops all of them."""
+    return os.path.join(default_info_dir(), f"node_{os.getpid()}.json")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="ray_tpu-node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="existing GCS address (worker node)")
+    p.add_argument("--port", type=int, default=0, help="GCS port (head only)")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--resources", default=None, help='JSON dict, e.g. \'{"A":1}\'')
+    p.add_argument("--labels", default=None, help="JSON dict of node labels")
+    p.add_argument("--info-file", default=None)
+    args = p.parse_args(argv)
+    if bool(args.head) == bool(args.address):
+        p.error("exactly one of --head / --address is required")
+
+    from ray_tpu._private.node import start_head, start_worker_node
+
+    resources = json.loads(args.resources) if args.resources else None
+    labels = json.loads(args.labels) if args.labels else None
+    common = dict(
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        resources=resources,
+        labels=labels,
+        object_store_memory=args.object_store_memory,
+    )
+    if args.head:
+        handle = start_head(gcs_port=args.port, **common)
+    else:
+        handle = start_worker_node(args.address, **common)
+
+    info = {
+        "pid": os.getpid(),
+        "gcs_address": handle.gcs_address,
+        "raylet_address": handle.raylet.address,
+        "store_socket": handle.store_socket,
+        "node_id": handle.node_id.hex(),
+        "session_dir": handle.session_dir,
+        "head": bool(args.head),
+    }
+    info_path = args.info_file or default_info_path()
+    os.makedirs(os.path.dirname(info_path), exist_ok=True)
+    with open(info_path, "w") as f:
+        json.dump(info, f)
+
+    # Readiness line for supervisors/tests (parsed like the store's READY).
+    print("RAY_TPU_NODE_READY " + json.dumps(info), flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    handle.shutdown()
+    try:
+        os.remove(info_path)
+    except OSError:
+        pass
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except KeyboardInterrupt:
+        sys.exit(0)

@@ -21,8 +21,10 @@ from dataclasses import dataclass
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError, GetTimeoutError
 
-OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_LIST, OP_STATS, OP_SHUTDOWN = range(1, 10)
+(OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_LIST,
+ OP_STATS, OP_SHUTDOWN, OP_SUBSCRIBE, OP_ABORT) = range(1, 12)
 ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_FULL, ST_TIMEOUT, ST_ERR, ST_EVICTED = range(7)
+EV_SEALED, EV_EVICTED = 1, 2
 
 # Sentinel returned by get() for objects that existed but were evicted —
 # the trigger for owner-side lineage reconstruction.
@@ -38,6 +40,17 @@ def build_store_binary() -> str:
     src = os.path.join(_CPP_DIR, "store.cpp")
     return build_native(src, "ray_tpu_store",
                         ["-O2", "-std=c++17", "-pthread"], ["-lrt"])
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        c = sock.recv(n)
+        if not c:
+            raise ConnectionError("object store connection closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
 
 
 def start_store(socket_path: str, capacity_bytes: int) -> subprocess.Popen:
@@ -102,20 +115,10 @@ class ObjectStoreClient:
         msg = struct.pack("<IB", 1 + len(object_id) + len(payload), op) + object_id + payload
         with self._lock:
             self._sock.sendall(msg)
-            header = self._recv_exact(4)
+            header = _recv_exact(self._sock, 4)
             (length,) = struct.unpack("<I", header)
-            body = self._recv_exact(length)
+            body = _recv_exact(self._sock, length)
         return body[0], body[1:]
-
-    def _recv_exact(self, n: int) -> bytes:
-        chunks = []
-        while n > 0:
-            c = self._sock.recv(n)
-            if not c:
-                raise ConnectionError("object store connection closed")
-            chunks.append(c)
-            n -= len(c)
-        return b"".join(chunks)
 
     # -- API --
 
@@ -241,6 +244,13 @@ class ObjectStoreClient:
     def delete(self, object_id: ObjectID) -> None:
         self._request(OP_DELETE, object_id.binary())
 
+    def abort(self, object_id: ObjectID) -> None:
+        """Drop an unsealed create server-side (failed write/pull); unlike
+        delete() this leaves no eviction tombstone, so a later create of the
+        same object succeeds cleanly."""
+        self.discard_pending(object_id)
+        self._request(OP_ABORT, object_id.binary())
+
     def contains(self, object_id: ObjectID) -> bool:
         st, _ = self._request(OP_CONTAINS, object_id.binary())
         return st == ST_OK
@@ -289,3 +299,49 @@ class ObjectStoreClient:
             return mmap.mmap(fd, size, prot=prot)
         finally:
             os.close(fd)
+
+
+class StoreEventSubscriber:
+    """Push stream of seal/evict events from the store daemon — the analog
+    of plasma's notification socket (reference: plasma clients subscribe for
+    sealed-object notifications; the raylet feeds the object directory from
+    it). callback(event: int, object_id_bytes: bytes) runs on the reader
+    thread; it must be quick or hand off."""
+
+    def __init__(self, socket_path: str, callback):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.connect(socket_path)
+        self._callback = callback
+        self._closed = threading.Event()
+        msg = struct.pack("<IB", 1 + 28, OP_SUBSCRIBE) + b"\x00" * 28
+        self._sock.sendall(msg)
+        header = _recv_exact(self._sock, 4)
+        (length,) = struct.unpack("<I", header)
+        body = _recv_exact(self._sock, length)
+        if body[0] != ST_OK:
+            raise RuntimeError(f"store subscribe failed: status {body[0]}")
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="store-events"
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        try:
+            while not self._closed.is_set():
+                header = _recv_exact(self._sock, 4)
+                (length,) = struct.unpack("<I", header)
+                body = _recv_exact(self._sock, length)
+                try:
+                    self._callback(body[0], body[1:29])
+                except Exception:  # noqa: BLE001 — subscriber must survive
+                    pass
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()

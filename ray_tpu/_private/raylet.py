@@ -32,13 +32,14 @@ import threading
 import time
 from typing import Any
 
+from ray_tpu._private import object_store as osmod
 from ray_tpu._private import scheduler as sched
 from ray_tpu._private import serialization as ser
 from ray_tpu._private import task_spec as ts
 from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import _ErrorPayload
-from ray_tpu._private.object_store import ObjectStoreClient
+from ray_tpu._private.object_store import ObjectStoreClient, StoreEventSubscriber
 from ray_tpu._private.rpc import RpcClient, RpcServer
 from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
 
@@ -94,11 +95,24 @@ class Raylet:
         self._actor_seq = 0  # tie-breaker for the per-actor method heap
         self._cluster_view: dict[bytes, dict] = {}
         self._stopped = threading.Event()
+        # inter-node object plane state
+        self._fetching: set[bytes] = set()  # pulls in flight
+        self._dep_fetch_ts: dict[bytes, float] = {}  # dep oid -> last fetch req
+        self._fetch_neg_ts: dict[bytes, float] = {}  # oid -> last unknown-result
+        # pending directory updates: ordered ("s"|"e", oid) pairs — order
+        # matters (evict-then-reseal within one batch must end as present)
+        self._dir_pending: list[tuple[str, bytes]] = []
+        self._dir_event = threading.Event()
 
         self.store = ObjectStoreClient(store_socket)
         self.gcs = RpcClient(gcs_address)
         self.server = RpcServer(self)
         self.address = self.server.address
+        # Feed the GCS object directory from the store's seal/evict stream
+        # (reference: the raylet learns sealed objects from plasma's
+        # notification socket and the directory resolves locations,
+        # object_manager/ownership_based_object_directory.cc:551).
+        self._store_events = StoreEventSubscriber(store_socket, self._on_store_event)
 
         self.gcs.call(
             "register_node",
@@ -114,6 +128,7 @@ class Raylet:
             threading.Thread(target=self._heartbeat_loop, daemon=True, name="raylet-hb"),
             threading.Thread(target=self._dep_loop, daemon=True, name="raylet-deps"),
             threading.Thread(target=self._dispatch_loop, daemon=True, name="raylet-dispatch"),
+            threading.Thread(target=self._dir_flush_loop, daemon=True, name="raylet-objdir"),
         ]
         for t in self._threads:
             t.start()
@@ -124,10 +139,12 @@ class Raylet:
         self._stopped.set()
         with self._dispatch_cv:
             self._dispatch_cv.notify_all()
+        self._dir_event.set()
         for w in list(self._all_workers.values()):
             if w.proc is not None:
                 w.proc.terminate()
         self.server.stop()
+        self._store_events.close()
         self.gcs.close()
         self.store.close()
 
@@ -165,6 +182,9 @@ class Raylet:
                             "store_socket": self.store_socket,
                         },
                     )
+                    # ...and its store contents: the object directory is
+                    # in-memory GCS state and died with the old incarnation
+                    self._republish_store_contents()
                 nodes = self.gcs.call("get_nodes")["nodes"]
                 with self._lock:
                     self._cluster_view = {
@@ -184,6 +204,164 @@ class Raylet:
                     self.gcs = RpcClient(self.gcs_address)
                 except Exception:  # noqa: BLE001
                     pass
+
+    # ------------- inter-node object plane -------------
+
+    def _on_store_event(self, ev: int, oid: bytes) -> None:
+        """Store seal/evict notification (runs on the subscriber thread)."""
+        with self._lock:
+            self._dir_pending.append(
+                ("s" if ev == osmod.EV_SEALED else "e", oid)
+            )
+        self._dir_event.set()
+
+    def _republish_store_contents(self) -> None:
+        """After a GCS restart the (in-memory) object directory is empty:
+        re-announce every object this node's store still holds, like the
+        node re-registration itself."""
+        try:
+            oids = self.store.list_objects()
+        except Exception:  # noqa: BLE001 — store unreachable mid-shutdown
+            return
+        with self._lock:
+            self._dir_pending.extend(("s", o.binary()) for o in oids)
+        self._dir_event.set()
+
+    def _dir_flush_loop(self) -> None:
+        """Batch location updates to the GCS directory: one RPC per burst of
+        seal/evict events instead of one per object."""
+        while not self._stopped.is_set():
+            self._dir_event.wait(timeout=1.0)
+            self._dir_event.clear()
+            if self._stopped.is_set():
+                return
+            with self._lock:
+                events, self._dir_pending = self._dir_pending, []
+            if not events:
+                continue
+            try:
+                self.gcs.call(
+                    "object_location_update",
+                    {
+                        "node_id": self.node_id.binary(),
+                        "events": [[ev, oid] for ev, oid in events],
+                    },
+                )
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                # GCS restarting: requeue and retry next tick (heartbeat
+                # loop heals the connection)
+                with self._lock:
+                    self._dir_pending = events + self._dir_pending
+                time.sleep(0.2)
+                self._dir_event.set()
+
+    def rpc_pull_object(self, conn, msgid, p):
+        """Serve one chunk of a local object to a pulling peer raylet
+        (reference: ObjectManager::Push chunked transfer,
+        object_manager.h:117 / object_buffer_pool.cc)."""
+        view = self.store.get(ObjectID(p["object_id"]), timeout_ms=0)
+        if view is None or view is osmod.EVICTED:
+            return {"ok": False}
+        total = len(view)
+        off = int(p.get("offset", 0))
+        length = int(p.get("length", total))
+        return {"ok": True, "size": total, "data": bytes(view[off : off + length])}
+
+    def rpc_fetch_object(self, conn, msgid, p):
+        """Worker/driver asks its raylet to pull an object into the local
+        store. Non-blocking: the caller keeps (blocking-)polling its local
+        store; the seal wakes it (reference: PullManager, pull_manager.h:52)."""
+        return {"status": self._request_fetch(p["object_id"])}
+
+    def _request_fetch(self, oid: bytes) -> str:
+        st = self.store.status(ObjectID(oid))
+        if st != "missing":
+            return "present" if st == "present" else "evicted"
+        # negative-result cache: getters poll while the producer still runs;
+        # don't turn every poll into a GCS directory lookup
+        now = time.monotonic()
+        neg = self._fetch_neg_ts.get(oid)
+        if neg is not None and now - neg < 0.5:
+            return "unknown"
+        try:
+            r = self.gcs.call("get_object_locations", {"object_id": oid})
+        except Exception:
+            return "unknown"
+        if not r.get("known"):
+            self._fetch_neg_ts[oid] = now
+            if len(self._fetch_neg_ts) > 10_000:
+                cutoff = now - 0.5
+                self._fetch_neg_ts = {
+                    k: v for k, v in self._fetch_neg_ts.items() if v > cutoff
+                }
+            return "unknown"
+        self._fetch_neg_ts.pop(oid, None)
+        locs = [l for l in r.get("nodes", ()) if l["node_id"] != self.node_id.binary()]
+        if not locs:
+            # directory tombstone (or every holder dead) → owners should
+            # lineage-reconstruct; no entry → producer hasn't sealed yet
+            return "evicted" if r.get("evicted") else "unknown"
+        with self._lock:
+            if oid in self._fetching:
+                return "fetching"
+            self._fetching.add(oid)
+        threading.Thread(
+            target=self._pull_object, args=(oid, locs), daemon=True,
+            name="raylet-pull",
+        ).start()
+        return "fetching"
+
+    def _pull_object(self, oid: bytes, locations: list[dict]) -> None:
+        """Pull one object chunk-by-chunk from a holder into the local store."""
+        cfg = global_config()
+        chunk = cfg.object_pull_chunk_bytes
+        obj = ObjectID(oid)
+        try:
+            for loc in locations:
+                created = False
+                try:
+                    peer = self._peer(loc["address"])
+                    r = peer.call(
+                        "pull_object", {"object_id": oid, "offset": 0, "length": chunk}
+                    )
+                    if not r.get("ok"):
+                        continue
+                    total = r["size"]
+                    try:
+                        buf = self.store.create(obj, total)
+                    except ValueError:
+                        return  # landed locally already (racing seal/pull)
+                    created = True
+                    data = r["data"]
+                    if total:
+                        buf[: len(data)] = data
+                    off = len(data)
+                    while off < total:
+                        r = peer.call(
+                            "pull_object",
+                            {"object_id": oid, "offset": off, "length": chunk},
+                        )
+                        if not r.get("ok") or not r["data"]:
+                            raise ConnectionError("holder dropped object mid-pull")
+                        data = r["data"]
+                        buf[off : off + len(data)] = data
+                        off += len(data)
+                    self.store.seal(obj)  # seal event publishes the location
+                    return
+                except Exception:  # noqa: BLE001 — try the next holder
+                    if created:
+                        try:
+                            self.store.abort(obj)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    continue
+        finally:
+            with self._lock:
+                self._fetching.discard(oid)
+            with self._dispatch_cv:
+                self._dispatch_cv.notify_all()
 
     # ------------- dependency resolution -------------
 
@@ -207,6 +385,15 @@ class Raylet:
                         break
                     if st == "present":
                         done.add(d)
+                        continue
+                    # missing locally: pull it if a peer holds it (throttled —
+                    # _request_fetch itself dedups in-flight pulls)
+                    now = time.monotonic()
+                    if now - self._dep_fetch_ts.get(d, 0.0) > 0.2:
+                        self._dep_fetch_ts[d] = now
+                        if self._request_fetch(d) == "evicted":
+                            evicted = d
+                            break
                 if evicted is not None:
                     # Fail the task with ObjectLostError; the owner's get()
                     # reconstructs from lineage and resubmits (worker.py
@@ -229,6 +416,8 @@ class Raylet:
                     continue
                 if done:
                     with self._lock:
+                        for d in done:
+                            self._dep_fetch_ts.pop(d, None)
                         remaining = self._missing_deps.get(task_id)
                         if remaining is not None:
                             remaining -= done

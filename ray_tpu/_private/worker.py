@@ -135,9 +135,29 @@ class CoreWorker:
         values = [self._get_one(r, deadline) for r in ref_list]
         return values[0] if single else values
 
+    def _maybe_fetch(self, oid: ObjectID, status: str | None = None) -> str | None:
+        """If the object is not in the LOCAL store, ask the raylet to pull it
+        from a peer node's store (reference: ray.get triggers the raylet's
+        PullManager for remote plasma objects). Pass `status` when the caller
+        already polled the local store to save the duplicate round-trip.
+        Returns the raylet's fetch status ('fetching'|'evicted'|'unknown'|
+        'present') or None when no fetch is needed/possible."""
+        try:
+            st = status if status is not None else self.store.status(oid)
+            if st != "missing":
+                return None  # present or locally-evicted: handled in-loop
+            r = self.raylet.call("fetch_object", {"object_id": oid.binary()})
+            return r.get("status")
+        except Exception:  # noqa: BLE001 — raylet unreachable; keep polling
+            return None
+
     def _get_one(self, ref: ObjectRef, deadline: float | None):
         oid = ref.object_id
         reconstruct_attempts = 0
+        if self._maybe_fetch(oid) == "evicted":
+            # evicted cluster-wide before we ever saw it
+            self._reconstruct(oid)
+            time.sleep(0.05)
         while True:
             remaining_ms = _GET_POLL_MS
             if deadline is not None:
@@ -148,6 +168,9 @@ class CoreWorker:
             try:
                 view = self.store.get(oid, timeout_ms=remaining_ms)
             except GetTimeoutError:
+                if self._maybe_fetch(oid) == "evicted":
+                    self._reconstruct(oid)
+                    time.sleep(0.05)
                 continue
             if view is osmod.EVICTED:
                 self._reconstruct(oid)
@@ -224,11 +247,22 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: list[ObjectRef] = []
+        last_fetch = 0.0
         while len(ready) < num_returns:
+            now = time.monotonic()
+            # objects produced on other nodes must be pulled into the local
+            # store before they can ever turn up present; reuse the same
+            # status poll for readiness and (throttled) fetch triggering
+            do_fetch = now - last_fetch > 0.2
+            if do_fetch:
+                last_fetch = now
             for r in list(pending):
-                if self.store.contains(r.object_id):
+                st = self.store.status(r.object_id)
+                if st == "present":
                     ready.append(r)
                     pending.remove(r)
+                elif do_fetch:
+                    self._maybe_fetch(r.object_id, status=st)
             if len(ready) >= num_returns:
                 break
             if deadline is not None and time.monotonic() >= deadline:

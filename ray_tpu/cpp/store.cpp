@@ -20,8 +20,11 @@
 //   resp: [u32 len][u8 status][payload]
 // ops: 1=CREATE(u64 size) -> shm name; 2=SEAL; 3=GET(u64 timeout_ms) ->
 //      shm name+size; 4=RELEASE; 5=DELETE; 6=CONTAINS; 7=LIST; 8=STATS;
-//      9=SHUTDOWN.
-// status: 0=OK 1=NOT_FOUND 2=EXISTS 3=FULL 4=TIMEOUT 5=ERR
+//      9=SHUTDOWN; 10=SUBSCRIBE (connection becomes a push-only event
+//      stream: [u32 len][u8 event][28B id], event 1=SEALED 2=EVICTED —
+//      the plasma→raylet notification socket analog, feeding the object
+//      directory); 11=ABORT (drop an unsealed create, e.g. failed pull).
+// status: 0=OK 1=NOT_FOUND 2=EXISTS 3=FULL 4=TIMEOUT 5=ERR 6=EVICTED
 //
 // Build: g++ -O2 -std=c++17 -pthread -o ray_tpu_store store.cpp -lrt
 
@@ -39,6 +42,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <list>
 #include <mutex>
 #include <string>
@@ -51,10 +55,13 @@ namespace {
 
 constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
                   OP_DELETE = 5, OP_CONTAINS = 6, OP_LIST = 7, OP_STATS = 8,
-                  OP_SHUTDOWN = 9;
+                  OP_SHUTDOWN = 9, OP_SUBSCRIBE = 10, OP_ABORT = 11;
 constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_FULL = 3,
                   ST_TIMEOUT = 4, ST_ERR = 5, ST_EVICTED = 6;
+constexpr uint8_t EV_SEALED = 1, EV_EVICTED = 2;
 constexpr size_t ID_SIZE = 28;
+
+bool WriteExact(int fd, const void *buf, size_t n);
 
 struct ObjectEntry {
   std::string shm_name;
@@ -70,6 +77,7 @@ class Store {
 
   uint8_t Create(const std::string &id, uint64_t size, std::string *shm_name) {
     std::unique_lock<std::mutex> lk(mu_);
+    if (closing_) return ST_ERR;  // shutting down: no new segments may appear
     if (objects_.count(id)) return ST_EXISTS;
     tombstones_.erase(id);  // reconstruction recreates an evicted object
     if (used_ + size > capacity_ && !EvictLocked(size)) return ST_FULL;
@@ -111,6 +119,7 @@ class Store {
     it->second.sealed = true;
     it->second.refcount--;  // drop creator ref; object now LRU-evictable at 0
     it->second.lru_tick = tick_++;
+    PushEventLocked(EV_SEALED, id);
     sealed_cv_.notify_all();
     return ST_OK;
   }
@@ -156,6 +165,7 @@ class Store {
     used_ -= it->second.size;
     objects_.erase(it);
     tombstones_.insert(id);
+    PushEventLocked(EV_EVICTED, id);
     return ST_OK;
   }
 
@@ -182,14 +192,89 @@ class Store {
     *count = objects_.size();
   }
 
+  // Final cleanup: gate new creates first, then unlink every segment. A
+  // create in flight when we take mu_ has already inserted its entry, so
+  // it gets unlinked here; creates arriving after see closing_ and fail.
   void UnlinkAll() {
     std::unique_lock<std::mutex> lk(mu_);
+    closing_ = true;
     for (auto &kv : objects_) shm_unlink(kv.second.shm_name.c_str());
     objects_.clear();
     used_ = 0;
   }
 
+  // -- event notification stream (plasma notification socket analog) --
+
+  // Registers the fd and sends the subscribe ACK under subs_mu_, so the
+  // ACK is ordered before any event the notifier writes to this fd and no
+  // seal after the client observes the ACK can be missed.
+  void Subscribe(int fd) {
+    std::unique_lock<std::mutex> lk(subs_mu_);
+    uint32_t len = 1;
+    std::string msg;
+    msg.append((char *)&len, 4);
+    msg.push_back((char)0 /* ST_OK */);
+    WriteExact(fd, msg.data(), msg.size());
+    sub_fds_.push_back(fd);
+  }
+
+  void StartNotifier() {
+    notifier_ = std::thread([this] { NotifierLoop(); });
+  }
+
+  void StopNotifier() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stopping_ = true;
+      events_cv_.notify_all();
+    }
+    if (notifier_.joinable()) notifier_.join();
+    std::unique_lock<std::mutex> lk(subs_mu_);
+    for (int fd : sub_fds_) close(fd);
+    sub_fds_.clear();
+  }
+
  private:
+  // Caller holds mu_. Events drain on a dedicated thread so a slow
+  // subscriber never blocks store operations.
+  void PushEventLocked(uint8_t ev, const std::string &id) {
+    std::string frame;
+    uint32_t len = 1 + (uint32_t)ID_SIZE;
+    frame.append((char *)&len, 4);
+    frame.push_back((char)ev);
+    frame.append(id);
+    events_.push_back(std::move(frame));
+    events_cv_.notify_one();
+  }
+
+  void NotifierLoop() {
+    for (;;) {
+      std::deque<std::string> batch;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        events_cv_.wait(lk, [&] { return !events_.empty() || stopping_; });
+        if (stopping_ && events_.empty()) return;
+        batch.swap(events_);
+      }
+      std::unique_lock<std::mutex> slk(subs_mu_);
+      for (auto it = sub_fds_.begin(); it != sub_fds_.end();) {
+        bool ok = true;
+        for (auto &f : batch) {
+          if (!WriteExact(*it, f.data(), f.size())) {
+            ok = false;
+            break;
+          }
+        }
+        if (!ok) {
+          close(*it);
+          it = sub_fds_.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+  }
+
   // LRU-evict sealed refcount==0 objects until `needed` fits. Caller holds mu_.
   bool EvictLocked(uint64_t needed) {
     while (used_ + needed > capacity_) {
@@ -208,6 +293,7 @@ class Store {
       used_ -= it->second.size;
       objects_.erase(it);
       tombstones_.insert(victim);
+      PushEventLocked(EV_EVICTED, victim);
     }
     return true;
   }
@@ -230,6 +316,14 @@ class Store {
   uint64_t used_ = 0;
   uint64_t tick_ = 0;
   uint64_t seq_ = 0;
+  bool closing_ = false;
+  // notification stream state
+  std::mutex subs_mu_;
+  std::vector<int> sub_fds_;
+  std::deque<std::string> events_;
+  std::condition_variable events_cv_;
+  bool stopping_ = false;
+  std::thread notifier_;
 };
 
 bool ReadExact(int fd, void *buf, size_t n) {
@@ -350,6 +444,17 @@ void ServeClient(Store *store, int fd) {
         SendResp(fd, ST_OK, out);
         break;
       }
+      case OP_ABORT:
+        store->Abort(id);
+        unsealed.erase(id);
+        SendResp(fd, ST_OK);
+        break;
+      case OP_SUBSCRIBE:
+        // Connection becomes a push-only event stream owned by the
+        // notifier thread; stop reading requests and do NOT close the fd.
+        // Subscribe() acks internally, ordered against notifier writes.
+        store->Subscribe(fd);
+        return;
       case OP_SHUTDOWN:
         SendResp(fd, ST_OK);
         g_shutdown = true;
@@ -371,10 +476,11 @@ Store *g_store = nullptr;
 const char *g_sock_path = nullptr;
 
 void HandleTerm(int) {
-  // Best-effort cleanup of shm segments + socket on SIGTERM/SIGINT.
-  if (g_store) g_store->UnlinkAll();
-  if (g_sock_path) unlink(g_sock_path);
-  _exit(0);
+  // Async-signal-safe only: flag shutdown and wake the accept loop; the
+  // main thread does the real cleanup (UnlinkAll takes a mutex, which must
+  // never happen inside a signal handler).
+  g_shutdown = true;
+  if (g_srv_fd >= 0) shutdown(g_srv_fd.load(), SHUT_RDWR);
 }
 
 int main(int argc, char **argv) {
@@ -408,6 +514,7 @@ int main(int argc, char **argv) {
     return 1;
   }
   g_srv_fd = srv;
+  store.StartNotifier();
   // Readiness handshake: parent waits for this line.
   printf("READY\n");
   fflush(stdout);
@@ -420,6 +527,7 @@ int main(int argc, char **argv) {
   }
   for (auto &t : threads)
     if (t.joinable()) t.detach();
+  store.StopNotifier();
   store.UnlinkAll();
   unlink(sock_path);
   return 0;

@@ -6,6 +6,9 @@ state CLI python/ray/experimental/state/state_cli.py `ray list|summary`;
 job CLI dashboard/modules/job/cli.py; `ray microbenchmark`
 python/ray/_private/ray_perf.py). Usage:
 
+    python -m ray_tpu.scripts.cli start --head [--port P] [--block]
+    python -m ray_tpu.scripts.cli start --address <gcs> [--block]
+    python -m ray_tpu.scripts.cli stop
     python -m ray_tpu.scripts.cli status --address <gcs>
     python -m ray_tpu.scripts.cli list tasks|actors|nodes --address <gcs>
     python -m ray_tpu.scripts.cli summary --address <gcs>
@@ -17,6 +20,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
+import subprocess
 import sys
 
 
@@ -27,6 +33,77 @@ def _connect(address: str | None):
         ray_tpu.init(address=address)
     elif not ray_tpu.is_initialized():
         raise SystemExit("--address required (no local cluster in this process)")
+
+
+def cmd_start(args) -> None:
+    """Start a cluster node as a real OS process (reference: `ray start`,
+    scripts.py:548). --block runs it in the foreground; the default spawns
+    a detached node process and returns once it reports ready."""
+    if bool(args.head) == bool(args.address):
+        raise SystemExit("exactly one of --head / --address is required")
+    node_argv = [sys.executable, "-m", "ray_tpu._private.node_main"]
+    if args.head:
+        node_argv += ["--head", "--port", str(args.port)]
+    else:
+        node_argv += ["--address", args.address]
+    if args.num_cpus is not None:
+        node_argv += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        node_argv += ["--num-tpus", str(args.num_tpus)]
+    if args.object_store_memory is not None:
+        node_argv += ["--object-store-memory", str(args.object_store_memory)]
+    if args.resources:
+        node_argv += ["--resources", args.resources]
+    if args.info_file:
+        # default: node_main writes a per-pid file under the nodes dir
+        node_argv += ["--info-file", args.info_file]
+
+    if args.block:
+        os.execv(sys.executable, node_argv)
+
+    proc = subprocess.Popen(
+        node_argv, stdout=subprocess.PIPE, stderr=None, start_new_session=True
+    )
+    line = proc.stdout.readline().decode()
+    if "RAY_TPU_NODE_READY" not in line:
+        raise SystemExit(f"node failed to start: {line!r}")
+    info = json.loads(line.split(" ", 1)[1])
+    kind = "head" if args.head else "worker"
+    print(f"started {kind} node pid={info['pid']} gcs={info['gcs_address']}")
+    if args.head:
+        print(f"to join:    ray_tpu start --address {info['gcs_address']}")
+        print(f"to connect: ray_tpu.init(address=\"{info['gcs_address']}\")")
+
+
+def cmd_stop(args) -> None:
+    """Stop node processes on this host. With --info-file, just that node;
+    otherwise every node recorded in the default nodes dir (the reference's
+    `ray stop` stops all local ray processes)."""
+    import glob
+
+    from ray_tpu._private.node_main import default_info_dir
+
+    if args.info_file:
+        info_files = [args.info_file]
+    else:
+        info_files = sorted(glob.glob(os.path.join(default_info_dir(), "*.json")))
+        if not info_files:
+            raise SystemExit(f"no nodes recorded in {default_info_dir()}")
+    for info_file in info_files:
+        try:
+            with open(info_file) as f:
+                info = json.load(f)
+        except OSError:
+            continue
+        try:
+            os.kill(info["pid"], signal.SIGTERM)
+            print(f"sent SIGTERM to node pid={info['pid']}")
+        except ProcessLookupError:
+            print(f"node pid={info['pid']} already gone")
+        try:
+            os.remove(info_file)
+        except OSError:
+            pass
 
 
 def cmd_status(args) -> None:
@@ -93,6 +170,21 @@ def build_parser() -> argparse.ArgumentParser:
     def with_address(sp):
         sp.add_argument("--address", help="GCS address host:port")
         return sp
+
+    st = sub.add_parser("start")
+    st.add_argument("--head", action="store_true")
+    st.add_argument("--address", help="existing GCS address (join as worker)")
+    st.add_argument("--port", type=int, default=0, help="GCS port (head)")
+    st.add_argument("--num-cpus", type=float, default=None)
+    st.add_argument("--num-tpus", type=float, default=None)
+    st.add_argument("--object-store-memory", type=int, default=None)
+    st.add_argument("--resources", default=None, help="JSON dict")
+    st.add_argument("--info-file", default=None)
+    st.add_argument("--block", action="store_true", help="run in foreground")
+    st.set_defaults(fn=cmd_start)
+    sp_stop = sub.add_parser("stop")
+    sp_stop.add_argument("--info-file", default=None)
+    sp_stop.set_defaults(fn=cmd_stop)
 
     with_address(sub.add_parser("status")).set_defaults(fn=cmd_status)
     lp = with_address(sub.add_parser("list"))

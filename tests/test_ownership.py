@@ -1,0 +1,169 @@
+"""Ownership & reference counting + native object spilling.
+
+Reference model: src/ray/core_worker/reference_count.h:61-115 (local refs,
+borrows, lineage pinning), src/ray/raylet/local_object_manager.cc (spill /
+restore under memory pressure), python/ray/_private/external_storage.py.
+Design here: ObjectRef __init__/__del__ drive per-worker local ref counts;
+primary copies are pinned in the node store while any ref lives; zero refs
+on the owner frees copies cluster-wide; the C++ store daemon spills pinned
+objects to disk under pressure and restores them on get.
+"""
+from __future__ import annotations
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+
+def _status(ref):
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().store.status(ref.object_id)
+
+
+@pytest.mark.parametrize(
+    "ray_start",
+    [{"num_cpus": 4, "object_store_memory": 16 * 1024 * 1024}],
+    indirect=True,
+)
+def test_live_ref_survives_store_pressure(ray_start):
+    """THE acceptance bar: eviction cannot lose an object with a live ref.
+    The primary copy is pinned; under pressure it spills and restores."""
+    rt = ray_start
+
+    @rt.remote
+    def produce():
+        return np.full(1024 * 1024, 7, dtype=np.uint8)  # 1MB
+
+    target = produce.remote()
+    rt.wait([target], timeout=120)
+
+    @rt.remote
+    def flood(i):
+        return np.zeros(2 * 1024 * 1024, dtype=np.uint8)
+
+    # 16 x 2MB = 2x capacity; every ref stays live, so nothing may be lost
+    floods = [flood.remote(i) for i in range(16)]
+    ready, pending = rt.wait(floods, num_returns=len(floods), timeout=240)
+    assert not pending
+
+    # the pinned target must still be readable WITHOUT reconstruction:
+    # wipe the lineage to prove no re-execution happens
+    from ray_tpu._private.worker import global_worker
+
+    global_worker()._lineage.clear()
+    out = rt.get(target, timeout=120)
+    assert out.shape == (1024 * 1024,) and out[0] == 7
+    # and every flooded object is intact too (2x capacity → some spilled)
+    for f in floods:
+        assert rt.get(f, timeout=120)[0] == 0
+
+
+@pytest.mark.parametrize(
+    "ray_start",
+    [{"num_cpus": 2, "object_store_memory": 16 * 1024 * 1024}],
+    indirect=True,
+)
+def test_put_2x_capacity_all_readable(ray_start):
+    """VERDICT #7 'done' criterion: put 2x store capacity, get everything."""
+    rt = ray_start
+    refs = [rt.put(np.full(1024 * 1024, i, np.uint8)) for i in range(32)]
+    for i, r in enumerate(refs):
+        assert rt.get(r, timeout=120)[0] == i
+
+
+def test_zero_refs_frees_object(ray_start):
+    """Owner's last ref dying frees the store copy cluster-wide."""
+    rt = ray_start
+    ref = rt.put(b"z" * (256 * 1024))
+    oid = ref.object_id
+    assert rt.get(ref, timeout=60) == b"z" * (256 * 1024)
+    del ref
+    gc.collect()
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if w.store.status(oid) != "present":
+            return
+        time.sleep(0.1)
+    pytest.fail("freed object still present in the store")
+
+
+def test_local_ref_counting_lifecycle(ray_start):
+    rt = ray_start
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    ref = rt.put(123)
+    oid = ref.object_id.binary()
+    assert w._local_refs.get(oid, 0) >= 1
+    ref2 = rt.ObjectRef(ref.object_id)  # second handle to the same object
+    assert w._local_refs[oid] >= 2
+    del ref2
+    gc.collect()
+    assert w._local_refs.get(oid, 0) >= 1
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and w._local_refs.get(oid, 0) > 0:
+        time.sleep(0.05)
+    assert w._local_refs.get(oid, 0) == 0
+
+
+def test_lineage_pinned_for_live_refs(ray_start):
+    """The lineage LRU must not age out specs whose objects still have live
+    refs (reference: lineage pinning, reference_count.h:67-115)."""
+    rt = ray_start
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+
+    @rt.remote
+    def make(i):
+        return i
+
+    pinned_ref = make.remote(-1)
+    rt.wait([pinned_ref], timeout=120)
+    old_cap = w._lineage_cap
+    w._lineage_cap = 8
+    try:
+        refs = [make.remote(i) for i in range(16)]  # flood the lineage LRU
+        rt.wait(refs, num_returns=len(refs), timeout=240)
+        assert pinned_ref.object_id.binary() in w._lineage, (
+            "live-ref lineage entry was evicted by the LRU"
+        )
+    finally:
+        w._lineage_cap = old_cap
+
+
+def test_spill_restore_roundtrip_store_level(tmp_path):
+    """Store-daemon-level spill/restore: fill beyond capacity with PINNED
+    objects; the daemon spills to disk and restores on get."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ObjectStoreClient, start_store
+
+    sock = str(tmp_path / "store.sock")
+    proc = start_store(sock, 4 * 1024 * 1024, spill_dir=str(tmp_path / "spill"))
+    try:
+        client = ObjectStoreClient(sock)
+        payloads = {}
+        for i in range(8):  # 8 x 1MB into a 4MB store
+            oid = ObjectID(bytes([i]) * 28)
+            data = bytes([i]) * (1024 * 1024)
+            buf = client.create(oid, len(data))
+            buf[:] = data
+            client.seal(oid)
+            client.pin(oid)  # pinned: must never be LOST
+            payloads[oid] = data
+        spilled = [p for p in (tmp_path / "spill").iterdir()]
+        assert spilled, "nothing was spilled despite 2x capacity of pins"
+        for oid, data in payloads.items():
+            got = client.get(oid, timeout_ms=5000)
+            assert got is not None and bytes(got) == data
+        client.close()
+    finally:
+        proc.terminate()
