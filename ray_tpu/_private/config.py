@@ -19,24 +19,21 @@ _ENV_PREFIX = "RAY_TPU_"
 @dataclass
 class Config:
     # --- core worker / scheduling ---
-    task_retry_delay_ms: int = 100
-    max_pending_lease_requests_per_scheduling_key: int = 10
-    worker_lease_timeout_ms: int = 10_000
-    max_direct_call_object_size: int = 100 * 1024  # inline small results in-band
-    task_rpc_inlined_bytes_limit: int = 10 * 1024 * 1024
+    task_retry_delay_ms: int = 100  # backoff before re-running a crashed task
     # --- object store ---
     object_store_memory_bytes: int = 512 * 1024 * 1024
-    object_store_full_delay_ms: int = 100
-    object_spilling_dir: str = ""  # default under session dir
-    min_spilling_size: int = 1 * 1024 * 1024
+    object_spilling_dir: str = ""  # default: <store socket>.spill
     object_pull_chunk_bytes: int = 8 * 1024 * 1024  # inter-node transfer chunk
     # --- raylet ---
     num_workers_soft_limit: int = -1  # default: num_cpus
     # generous: several python workers cold-spawning serially on a loaded
     # single-CPU host can take 5-10s each
     worker_register_timeout_s: int = 60
-    kill_idle_workers_interval_ms: int = 200
-    idle_worker_killing_time_threshold_ms: int = 1000
+    # idle task workers beyond this age are reaped down to one warm worker
+    # (reference: worker_pool idle killing); generous default — cold spawn
+    # costs seconds on a busy host
+    kill_idle_workers_interval_ms: int = 5_000
+    idle_worker_killing_time_threshold_ms: int = 300_000
     # --- GCS ---
     gcs_heartbeat_interval_ms: int = 1000
     health_check_failure_threshold: int = 5

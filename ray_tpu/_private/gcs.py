@@ -307,6 +307,7 @@ class GcsService:
         evict-then-reseal within one batch must end as present."""
         nid = p["node_id"]
         now = time.monotonic()
+        late_frees: list[tuple[bytes, bytes]] = []  # (node_id, oid)
         with self._lock:
             for ev, oid in p["events"]:
                 e = self.object_dir.get(oid)
@@ -316,6 +317,10 @@ class GcsService:
                     e["nodes"].add(nid)
                     e["evicted"] = False
                     self._dir_tombstone_ts.pop(oid, None)
+                    if e.get("freed"):
+                        # owner freed this object before it was ever sealed
+                        # (fire-and-forget task result): free it now
+                        late_frees.append((nid, oid))
                 else:
                     if e is None:
                         continue
@@ -323,6 +328,32 @@ class GcsService:
                     if not e["nodes"]:
                         e["evicted"] = True  # tombstone: owners reconstruct
                         self._dir_tombstone_ts[oid] = now
+        for nid_, oid in late_frees:
+            self._free_on_node(nid_, oid)
+        return {"ok": True}
+
+    def _free_on_node(self, node_id: bytes, oid: bytes) -> None:
+        try:
+            self._raylet(node_id).call_async("free_object", {"object_id": oid})
+        except Exception:  # noqa: BLE001 — holder died; nothing to free
+            pass
+
+    def rpc_free_object(self, conn, msgid, p):
+        """Owner reports zero references: release the object's copies
+        everywhere (reference: zero-ref plasma free driven by the owner's
+        ReferenceCounter). Idempotent; copies sealed later are freed on
+        arrival via the 'freed' flag."""
+        oid = p["object_id"]
+        with self._lock:
+            e = self.object_dir.get(oid)
+            if e is None:
+                e = self.object_dir[oid] = {"nodes": set(), "evicted": False}
+            e["freed"] = True
+            holders = list(e["nodes"])
+            # freed entries are garbage: let the tombstone sweep reclaim them
+            self._dir_tombstone_ts.setdefault(oid, time.monotonic())
+        for nid in holders:
+            self._free_on_node(nid, oid)
         return {"ok": True}
 
     def rpc_get_object_locations(self, conn, msgid, p):

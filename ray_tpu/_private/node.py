@@ -96,7 +96,9 @@ def start_head(
     session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
     store_socket = os.path.join(session_dir, "store.sock")
     store_proc = start_store(
-        store_socket, object_store_memory or cfg.object_store_memory_bytes
+        store_socket,
+        object_store_memory or cfg.object_store_memory_bytes,
+        spill_dir=cfg.object_spilling_dir or None,
     )
     # build+load the native scheduling core NOW so the first dispatch never
     # stalls on a synchronous g++ compile
@@ -140,7 +142,9 @@ def start_worker_node(
     session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
     store_socket = os.path.join(session_dir, "store.sock")
     store_proc = start_store(
-        store_socket, object_store_memory or cfg.object_store_memory_bytes
+        store_socket,
+        object_store_memory or cfg.object_store_memory_bytes,
+        spill_dir=cfg.object_spilling_dir or None,
     )
     node_resources, node_labels = _default_node_resources(
         num_cpus, num_tpus, resources, labels
@@ -205,7 +209,9 @@ class Cluster:
             self.head.session_dir, f"store-{len(self.nodes)}.sock"
         )
         store_proc = start_store(
-            store_socket, object_store_memory or cfg.object_store_memory_bytes
+            store_socket,
+            object_store_memory or cfg.object_store_memory_bytes,
+            spill_dir=cfg.object_spilling_dir or None,
         )
         raylet = Raylet(
             NodeID.from_random(),

@@ -10,6 +10,13 @@ from __future__ import annotations
 
 from ray_tpu._private.ids import ObjectID
 
+# Reference-counting hooks, installed by worker.set_global_worker: every
+# live ObjectRef instance counts as one local reference in the hosting
+# CoreWorker (reference: reference_count.h — local refs tracked per ref
+# instance; a deserialized ref counts on the borrower's side).
+_on_ref_created = None
+_on_ref_deleted = None
+
 
 class ObjectRef:
     __slots__ = ("object_id", "_owner_hint")
@@ -17,6 +24,20 @@ class ObjectRef:
     def __init__(self, object_id: ObjectID, owner_hint: str = ""):
         self.object_id = object_id
         self._owner_hint = owner_hint
+        cb = _on_ref_created
+        if cb is not None:
+            try:
+                cb(object_id.binary())
+            except Exception:  # noqa: BLE001 — never break ref construction
+                pass
+
+    def __del__(self):
+        cb = _on_ref_deleted
+        if cb is not None:
+            try:
+                cb(self.object_id.binary())
+            except Exception:  # noqa: BLE001 — interpreter may be tearing down
+                pass
 
     def hex(self) -> str:
         return self.object_id.hex()

@@ -22,7 +22,7 @@ from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError, GetTimeoutError
 
 (OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_LIST,
- OP_STATS, OP_SHUTDOWN, OP_SUBSCRIBE, OP_ABORT) = range(1, 12)
+ OP_STATS, OP_SHUTDOWN, OP_SUBSCRIBE, OP_ABORT, OP_PIN, OP_UNPIN) = range(1, 14)
 ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_FULL, ST_TIMEOUT, ST_ERR, ST_EVICTED = range(7)
 EV_SEALED, EV_EVICTED = 1, 2
 
@@ -53,11 +53,47 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def start_store(socket_path: str, capacity_bytes: int) -> subprocess.Popen:
-    """Launch the daemon and wait for its READY handshake."""
+def _gc_stale_segments() -> None:
+    """Unlink rt_store shm segments whose creating daemon is dead — a
+    crash/teardown race can orphan a segment; this makes every store start
+    self-healing instead of letting tmpfs fill over weeks of runs."""
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith("rt_store_"):
+            continue
+        try:
+            pid = int(name.split("_")[2])
+        except (IndexError, ValueError):
+            continue
+        try:
+            os.kill(pid, 0)  # raises if the daemon is gone
+        except ProcessLookupError:
+            try:
+                os.unlink("/dev/shm/" + name)
+            except OSError:
+                pass
+        except PermissionError:
+            pass  # someone else's live process
+
+
+def start_store(
+    socket_path: str, capacity_bytes: int, spill_dir: str | None = None
+) -> subprocess.Popen:
+    """Launch the daemon and wait for its READY handshake. spill_dir
+    defaults to <socket>.spill next to the socket; pass "" to disable
+    spilling (pressure then fails creates instead)."""
     binary = build_store_binary()
+    _gc_stale_segments()
+    if spill_dir is None:
+        spill_dir = socket_path + ".spill"
+    argv = [binary, socket_path, str(capacity_bytes)]
+    if spill_dir:
+        argv.append(spill_dir)
     proc = subprocess.Popen(
-        [binary, socket_path, str(capacity_bytes)],
+        argv,
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
     )
@@ -160,8 +196,12 @@ class ObjectStoreClient:
         if m is not None:
             m.close()
 
-    def seal(self, object_id: ObjectID) -> None:
-        st, _ = self._request(OP_SEAL, object_id.binary())
+    def seal(self, object_id: ObjectID, pin: bool = False) -> None:
+        """pin=True seals AND pins atomically (primary copies): the object
+        can spill under pressure but never be LRU-evicted until unpinned."""
+        st, _ = self._request(
+            OP_SEAL, object_id.binary(), b"\x01" if pin else b""
+        )
         if st != ST_OK:
             raise RuntimeError(f"seal failed: status {st}")
         key = object_id.binary()
@@ -181,34 +221,44 @@ class ObjectStoreClient:
             if cached is not None:
                 self._mappings.move_to_end(key)
                 return cached.buf
-        st, payload = self._request(OP_GET, key, struct.pack("<Q", timeout_ms))
-        if st == ST_NOT_FOUND:
-            return None
-        if st == ST_EVICTED:
-            return EVICTED
-        if st == ST_TIMEOUT:
-            raise GetTimeoutError(f"get({object_id}) timed out after {timeout_ms}ms")
-        if st != ST_OK:
-            raise RuntimeError(f"get failed: status {st}")
-        (size,) = struct.unpack("<Q", payload[:8])
-        shm_name = payload[8:].decode()
-        try:
-            with self._map_lock:
-                if key in self._mappings:
-                    self._mappings.move_to_end(key)
-                    return self._mappings[key].buf
-            if size == 0:
-                m = _Mapping(memoryview(b""), None)
-            else:
-                mm = self._map(shm_name, size, writable=False)
-                m = _Mapping(memoryview(mm), mm)
-            return self._cache_mapping(key, m).buf
-        finally:
-            # Drop the server-side pin taken by OP_GET as soon as the mmap
-            # exists: our mapping keeps the pages valid locally even if the
-            # server evicts, and late readers reconstruct from lineage.
-            # Pinned bytes on the server thus stay transient.
-            self._request(OP_RELEASE, key)
+        # Bounded retry: between the OP_GET reply and our shm_open the
+        # server may SPILL the object (unlinking its segment) under memory
+        # pressure; a re-request restores it into a fresh segment.
+        for _ in range(8):
+            st, payload = self._request(OP_GET, key, struct.pack("<Q", timeout_ms))
+            if st == ST_NOT_FOUND:
+                return None
+            if st == ST_EVICTED:
+                return EVICTED
+            if st == ST_TIMEOUT:
+                raise GetTimeoutError(f"get({object_id}) timed out after {timeout_ms}ms")
+            if st != ST_OK:
+                raise RuntimeError(f"get failed: status {st}")
+            (size,) = struct.unpack("<Q", payload[:8])
+            shm_name = payload[8:].decode()
+            try:
+                with self._map_lock:
+                    if key in self._mappings:
+                        self._mappings.move_to_end(key)
+                        return self._mappings[key].buf
+                if size == 0:
+                    m = _Mapping(memoryview(b""), None)
+                else:
+                    try:
+                        mm = self._map(shm_name, size, writable=False)
+                    except FileNotFoundError:
+                        continue  # segment spilled mid-handshake: re-request
+                    m = _Mapping(memoryview(mm), mm)
+                return self._cache_mapping(key, m).buf
+            finally:
+                # Drop the server-side pin taken by OP_GET as soon as the
+                # mmap exists: our mapping keeps the pages valid locally even
+                # if the server evicts, and late readers reconstruct from
+                # lineage. Pinned bytes on the server thus stay transient.
+                self._request(OP_RELEASE, key)
+        raise RuntimeError(
+            f"get({object_id}): segment vanished {8} times (spill thrash)"
+        )
 
     def _cache_mapping(self, key: bytes, m: _Mapping, replace: bool = False) -> _Mapping:
         """Insert-or-get under the lock; loser of a concurrent double-fetch
@@ -250,6 +300,15 @@ class ObjectStoreClient:
         same object succeeds cleanly."""
         self.discard_pending(object_id)
         self._request(OP_ABORT, object_id.binary())
+
+    def pin(self, object_id: ObjectID) -> bool:
+        """Long-lived reference (primary-copy pin): the object may spill
+        under pressure but can never be LRU-evicted while pinned."""
+        st, _ = self._request(OP_PIN, object_id.binary())
+        return st == ST_OK
+
+    def unpin(self, object_id: ObjectID) -> None:
+        self._request(OP_UNPIN, object_id.binary())
 
     def contains(self, object_id: ObjectID) -> bool:
         st, _ = self._request(OP_CONTAINS, object_id.binary())

@@ -99,6 +99,12 @@ class Raylet:
         self._fetching: set[bytes] = set()  # pulls in flight
         self._dep_fetch_ts: dict[bytes, float] = {}  # dep oid -> last fetch req
         self._fetch_neg_ts: dict[bytes, float] = {}  # oid -> last unknown-result
+        # primary-copy pinning (reference: raylet pins objects for live refs,
+        # node_manager.cc:2416 PinObjectIDs): objects SEALED on this node are
+        # pinned until the owner frees them; objects PULLED here are
+        # secondary copies and stay LRU-evictable
+        self._secondary: set[bytes] = set()  # oids being pulled (skip pin)
+        self._pinned: set[bytes] = set()
         # pending directory updates: ordered ("s"|"e", oid) pairs — order
         # matters (evict-then-reseal within one batch must end as present)
         self._dir_pending: list[tuple[str, bytes]] = []
@@ -129,6 +135,7 @@ class Raylet:
             threading.Thread(target=self._dep_loop, daemon=True, name="raylet-deps"),
             threading.Thread(target=self._dispatch_loop, daemon=True, name="raylet-dispatch"),
             threading.Thread(target=self._dir_flush_loop, daemon=True, name="raylet-objdir"),
+            threading.Thread(target=self._idle_reaper_loop, daemon=True, name="raylet-reaper"),
         ]
         for t in self._threads:
             t.start()
@@ -205,6 +212,38 @@ class Raylet:
                 except Exception:  # noqa: BLE001
                     pass
 
+    def _idle_reaper_loop(self) -> None:
+        """Reap long-idle task workers down to one warm worker so an idle
+        node releases memory (reference: worker_pool.cc idle worker killing,
+        kill_idle_workers_interval_ms / idle_worker_killing_time_threshold)."""
+        cfg = global_config()
+        interval = cfg.kill_idle_workers_interval_ms / 1000.0
+        threshold = cfg.idle_worker_killing_time_threshold_ms / 1000.0
+        while not self._stopped.wait(interval):
+            now = time.monotonic()
+            victims = []
+            with self._lock:
+                if len(self._idle_workers) <= 1:
+                    continue
+                # oldest-idle first; always keep one warm worker (cold spawn
+                # costs seconds)
+                for w in sorted(self._idle_workers, key=lambda w: w.last_idle):
+                    if len(self._idle_workers) - len(victims) <= 1:
+                        break
+                    if now - w.last_idle > threshold:
+                        victims.append(w)
+                for w in victims:
+                    self._idle_workers.remove(w)
+                    self._all_workers.pop(w.worker_id, None)
+            for w in victims:
+                try:
+                    if w.conn is not None:
+                        w.conn.close()
+                    if w.proc is not None:
+                        w.proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+
     # ------------- inter-node object plane -------------
 
     def _on_store_event(self, ev: int, oid: bytes) -> None:
@@ -213,6 +252,15 @@ class Raylet:
             self._dir_pending.append(
                 ("s" if ev == osmod.EV_SEALED else "e", oid)
             )
+            if ev == osmod.EV_SEALED:
+                if oid in self._secondary:
+                    self._secondary.discard(oid)  # pulled copy: evictable
+                else:
+                    # primary copies pin themselves atomically at seal
+                    # (seal(pin=True)); track so free_object unpins once
+                    self._pinned.add(oid)
+            else:
+                self._pinned.discard(oid)
         self._dir_event.set()
 
     def _republish_store_contents(self) -> None:
@@ -277,18 +325,19 @@ class Raylet:
 
     def _request_fetch(self, oid: bytes) -> str:
         st = self.store.status(ObjectID(oid))
-        if st != "missing":
-            return "present" if st == "present" else "evicted"
-        # negative-result cache: getters poll while the producer still runs;
-        # don't turn every poll into a GCS directory lookup
+        if st == "present":
+            return "present"
+        # st is "missing" OR "evicted": a LOCAL tombstone (e.g. an LRU-evicted
+        # secondary copy) does not mean the object is gone cluster-wide —
+        # consult the directory; re-pulling clears the tombstone via create()
         now = time.monotonic()
         neg = self._fetch_neg_ts.get(oid)
         if neg is not None and now - neg < 0.5:
-            return "unknown"
+            return "evicted" if st == "evicted" else "unknown"
         try:
             r = self.gcs.call("get_object_locations", {"object_id": oid})
         except Exception:
-            return "unknown"
+            return "evicted" if st == "evicted" else "unknown"
         if not r.get("known"):
             self._fetch_neg_ts[oid] = now
             if len(self._fetch_neg_ts) > 10_000:
@@ -296,13 +345,14 @@ class Raylet:
                 self._fetch_neg_ts = {
                     k: v for k, v in self._fetch_neg_ts.items() if v > cutoff
                 }
-            return "unknown"
+            # no directory entry: trust local knowledge (it existed and died)
+            return "evicted" if st == "evicted" else "unknown"
         self._fetch_neg_ts.pop(oid, None)
         locs = [l for l in r.get("nodes", ()) if l["node_id"] != self.node_id.binary()]
         if not locs:
             # directory tombstone (or every holder dead) → owners should
             # lineage-reconstruct; no entry → producer hasn't sealed yet
-            return "evicted" if r.get("evicted") else "unknown"
+            return "evicted" if (r.get("evicted") or st == "evicted") else "unknown"
         with self._lock:
             if oid in self._fetching:
                 return "fetching"
@@ -329,6 +379,10 @@ class Raylet:
                     if not r.get("ok"):
                         continue
                     total = r["size"]
+                    with self._lock:
+                        # mark BEFORE create/seal so the seal event sees a
+                        # secondary copy and does not pin it
+                        self._secondary.add(oid)
                     try:
                         buf = self.store.create(obj, total)
                     except ValueError:
@@ -356,12 +410,32 @@ class Raylet:
                             self.store.abort(obj)
                         except Exception:  # noqa: BLE001
                             pass
+                    with self._lock:
+                        # no seal event will clear it; a later PRIMARY seal
+                        # of this oid must not be mistaken for a pulled copy
+                        self._secondary.discard(oid)
                     continue
         finally:
             with self._lock:
                 self._fetching.discard(oid)
             with self._dispatch_cv:
                 self._dispatch_cv.notify_all()
+
+    def rpc_free_object(self, conn, msgid, p):
+        """Owner's refs hit zero: unpin and drop the local copy (routed via
+        the GCS directory; reference: ReferenceCounter zero-ref → plasma
+        free, reference_count.h:61-115)."""
+        oid = p["object_id"]
+        with self._lock:
+            pinned = oid in self._pinned
+            self._pinned.discard(oid)
+        try:
+            if pinned:
+                self.store.unpin(ObjectID(oid))
+            self.store.delete(ObjectID(oid))
+        except Exception:  # noqa: BLE001 — store tearing down
+            pass
+        return {"ok": True}
 
     # ------------- dependency resolution -------------
 
@@ -380,14 +454,13 @@ class Raylet:
                 evicted = None
                 for d in deps:
                     st = self.store.status(ObjectID(d))
-                    if st == "evicted":
-                        evicted = d
-                        break
                     if st == "present":
                         done.add(d)
                         continue
-                    # missing locally: pull it if a peer holds it (throttled —
-                    # _request_fetch itself dedups in-flight pulls)
+                    # missing (or tombstoned) locally: pull it if a peer
+                    # holds a copy (throttled — _request_fetch dedups
+                    # in-flight pulls); only a CLUSTER-WIDE "evicted" fails
+                    # the task so a local tombstone never masks a live copy
                     now = time.monotonic()
                     if now - self._dep_fetch_ts.get(d, 0.0) > 0.2:
                         self._dep_fetch_ts[d] = now
@@ -491,9 +564,18 @@ class Raylet:
     def _on_task_worker_death(self, spec: dict) -> None:
         if spec["retry_count"] < spec["max_retries"]:
             spec = dict(spec, retry_count=spec["retry_count"] + 1)
-            with self._dispatch_cv:
-                self._enqueue_locked(spec)
-                self._dispatch_cv.notify_all()
+            delay = global_config().task_retry_delay_ms / 1000.0
+
+            def _requeue():
+                if delay > 0 and self._stopped.wait(delay):
+                    return
+                with self._dispatch_cv:
+                    self._enqueue_locked(spec)
+                    self._dispatch_cv.notify_all()
+
+            # backoff before the retry so a crash-looping task doesn't spin
+            # the dispatch path (reference: task_retry_delay_ms)
+            threading.Thread(target=_requeue, daemon=True).start()
         else:
             self._seal_error(
                 spec,
@@ -970,7 +1052,7 @@ class Raylet:
                 size = ser.serialized_size(chunks)
                 buf = self.store.create(oid, size)
                 ser.write_chunks(chunks, buf)
-                self.store.seal(oid)
+                self.store.seal(oid, pin=True)  # primary copy
             except ValueError:
                 pass  # already exists (duplicate failure path) — keep first
             except Exception:

@@ -78,6 +78,16 @@ class CoreWorker:
         self._lineage: "OrderedDict[bytes, dict]" = OrderedDict()
         self._lineage_cap = 100_000
         self._inflight_resubmits: set[bytes] = set()
+        # ---- ownership & local reference counting ----
+        # (reference: reference_count.h:61-115 — local refs per ObjectRef
+        # instance, submitted-task argument references, lineage pinned for
+        # live refs, zero refs on the owner → free copies cluster-wide)
+        self._ref_lock = threading.RLock()  # RLock: __del__ may re-enter
+        self._local_refs: dict[bytes, int] = {}
+        self._owned: set[bytes] = set()  # oids created by this worker's
+        #                                  puts/submits (it may free them)
+        self._dep_holds: dict[bytes, int] = {}  # arg refs of in-flight tasks
+        self._task_dep_holds: dict[bytes, list[bytes]] = {}  # task -> deps
         # actor bookkeeping (submitter side)
         self._actor_seqnos: dict[bytes, int] = {}
         self._actor_raylet: dict[bytes, str] = {}  # actor_id -> raylet addr
@@ -102,6 +112,68 @@ class CoreWorker:
     def add_notify_handler(self, topic: str, handler) -> None:
         self._notify_handlers.setdefault(topic, []).append(handler)
 
+    # ---------------- reference counting ----------------
+
+    def add_local_ref(self, oid: bytes) -> None:
+        with self._ref_lock:
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+
+    def remove_local_ref(self, oid: bytes) -> None:
+        free = False
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+            else:
+                self._local_refs.pop(oid, None)
+                if n == 0 and oid in self._owned and not self._dep_holds.get(oid):
+                    self._owned.discard(oid)
+                    free = True
+        if free:
+            self._free_object(oid)
+
+    def _add_dep_holds(self, task_id: bytes, deps: list[bytes]) -> None:
+        """Pin task arguments until the task is observed complete — a ref
+        the user dropped must survive for the task that consumes it
+        (reference: submitted-task references in reference_count.h)."""
+        if not deps:
+            return
+        with self._ref_lock:
+            self._task_dep_holds.setdefault(task_id, []).extend(deps)
+            for d in deps:
+                self._dep_holds[d] = self._dep_holds.get(d, 0) + 1
+
+    def _release_task_dep_holds(self, task_id: bytes) -> None:
+        """Called when a task's result is observed (its deps are consumed)."""
+        with self._ref_lock:
+            deps = self._task_dep_holds.pop(task_id, None)
+        if not deps:
+            return
+        to_free = []
+        with self._ref_lock:
+            for d in deps:
+                n = self._dep_holds.get(d, 0) - 1
+                if n > 0:
+                    self._dep_holds[d] = n
+                else:
+                    self._dep_holds.pop(d, None)
+                    if (
+                        n == 0
+                        and not self._local_refs.get(d)
+                        and d in self._owned
+                    ):
+                        self._owned.discard(d)
+                        to_free.append(d)
+        for d in to_free:
+            self._free_object(d)
+
+    def _free_object(self, oid: bytes) -> None:
+        """Zero references on the owner: release copies cluster-wide."""
+        try:
+            self.gcs.call_async("free_object", {"object_id": oid})
+        except Exception:  # noqa: BLE001 — shutting down
+            pass
+
     # ---------------- object API ----------------
 
     def put(self, value: Any) -> ObjectRef:
@@ -109,6 +181,8 @@ class CoreWorker:
             self._put_counter += 1
             oid = ObjectID.for_put(self.task_id, self._put_counter)
         self.put_object(oid, value)
+        with self._ref_lock:
+            self._owned.add(oid.binary())
         return ObjectRef(oid)
 
     def put_object(self, oid: ObjectID, value: Any) -> None:
@@ -123,7 +197,10 @@ class CoreWorker:
             return
         try:
             ser.write_chunks(chunks, buf)
-            self.store.seal(oid)
+            # primary copy: pinned atomically at seal so eviction can never
+            # lose an object whose owner still holds references; the raylet
+            # unpins it when the owner's refs hit zero (free_object)
+            self.store.seal(oid, pin=True)
         except BaseException:
             self.store.discard_pending(oid)
             raise
@@ -144,8 +221,10 @@ class CoreWorker:
         'present') or None when no fetch is needed/possible."""
         try:
             st = status if status is not None else self.store.status(oid)
-            if st != "missing":
-                return None  # present or locally-evicted: handled in-loop
+            if st == "present":
+                return None
+            # "missing" AND "evicted" both go to the raylet: a local
+            # tombstone may hide a live copy on another node
             r = self.raylet.call("fetch_object", {"object_id": oid.binary()})
             return r.get("status")
         except Exception:  # noqa: BLE001 — raylet unreachable; keep polling
@@ -173,6 +252,12 @@ class CoreWorker:
                     time.sleep(0.05)
                 continue
             if view is osmod.EVICTED:
+                # prefer re-pulling a live copy from another node over
+                # re-executing the creating task
+                st = self._maybe_fetch(oid, status="evicted")
+                if st in ("fetching", "present"):
+                    time.sleep(0.01)
+                    continue
                 self._reconstruct(oid)
                 # the resubmitted task needs time to run; don't hammer the
                 # store socket while it does
@@ -188,6 +273,8 @@ class CoreWorker:
                     and oid.binary() in self._lineage
                     and reconstruct_attempts < 3
                 ):
+                    # NOTE: dep holds are NOT released on this branch — the
+                    # resubmitted task still needs its argument objects
                     # A dependency of the creating task was evicted and the
                     # raylet failed the task; clear the error payloads and
                     # re-run the lineage (deps reconstructed recursively).
@@ -199,9 +286,14 @@ class CoreWorker:
                     self._reconstruct(oid)
                     time.sleep(0.05)
                     continue
+                # terminal error: the creating task is done for good — its
+                # argument references can be released
+                self._release_task_dep_holds(oid.task_id().binary())
                 if isinstance(err, TaskError) and err.cause is not None:
                     raise err.cause from None
                 raise err
+            # real result observed: the creating task finished
+            self._release_task_dep_holds(oid.task_id().binary())
             return value
 
     def _reconstruct(self, oid: ObjectID) -> None:
@@ -294,16 +386,38 @@ class CoreWorker:
             task_id=spec["task_id"], job_id=spec["job_id"], name=spec["name"],
             event="SUBMITTED", task_type=spec["type"],
         )
+        with self._ref_lock:
+            self._owned.update(r.object_id.binary() for r in refs)
+        self._add_dep_holds(spec["task_id"], list(spec["arg_deps"]))
         with self._task_lock:
             for r in refs:
                 self._lineage[r.object_id.binary()] = spec
-            while len(self._lineage) > self._lineage_cap:
-                self._lineage.popitem(last=False)
+            self._trim_lineage_locked()
         self.raylet.call("submit_task", {"spec": spec})
         return refs
 
+    def _trim_lineage_locked(self) -> None:
+        """LRU-bound the lineage, but PIN entries whose objects still have
+        live references — those must stay reconstructible (reference:
+        lineage pinning, reference_count.h:67-115)."""
+        attempts = len(self._lineage)
+        while len(self._lineage) > self._lineage_cap and attempts > 0:
+            attempts -= 1
+            oid, spec = self._lineage.popitem(last=False)
+            with self._ref_lock:
+                live = any(
+                    self._local_refs.get(r.binary())
+                    or self._dep_holds.get(r.binary())
+                    for r in ts.return_object_ids(spec)
+                )
+            if live:
+                self._lineage[oid] = spec  # reinsert at the fresh end
+
     def submit_actor_task(self, spec: dict, raylet_address: str | None) -> list[ObjectRef]:
         refs = [ObjectRef(o) for o in ts.return_object_ids(spec)]
+        with self._ref_lock:
+            self._owned.update(r.object_id.binary() for r in refs)
+        self._add_dep_holds(spec["task_id"], list(spec["arg_deps"]))
         client = self.raylet
         if raylet_address and raylet_address != self.raylet.address:
             client = self._peer(raylet_address)
@@ -496,8 +610,16 @@ _global_lock = threading.Lock()
 
 def set_global_worker(w: CoreWorker | None) -> None:
     global _global_worker
+    from ray_tpu._private import object_ref as _or
+
     with _global_lock:
         _global_worker = w
+        if w is None:
+            _or._on_ref_created = None
+            _or._on_ref_deleted = None
+        else:
+            _or._on_ref_created = w.add_local_ref
+            _or._on_ref_deleted = w.remove_local_ref
 
 
 def global_worker() -> CoreWorker:

@@ -23,8 +23,18 @@
 //      9=SHUTDOWN; 10=SUBSCRIBE (connection becomes a push-only event
 //      stream: [u32 len][u8 event][28B id], event 1=SEALED 2=EVICTED —
 //      the plasma→raylet notification socket analog, feeding the object
-//      directory); 11=ABORT (drop an unsealed create, e.g. failed pull).
+//      directory); 11=ABORT (drop an unsealed create, e.g. failed pull);
+//      12=PIN / 13=UNPIN (long-lived reference by the raylet for primary
+//      copies — pinned objects are never LRU-evicted, only spilled).
 // status: 0=OK 1=NOT_FOUND 2=EXISTS 3=FULL 4=TIMEOUT 5=ERR 6=EVICTED
+//
+// Spilling (reference: raylet/local_object_manager.cc spill/restore +
+// external_storage.py — here implemented natively inside the daemon):
+// under memory pressure, unreferenced sealed objects are LRU-EVICTED
+// (recoverable via lineage); referenced/pinned sealed objects are SPILLED
+// to <spill_dir> and transparently restored into fresh shm on the next
+// Get. argv: <socket> <capacity> [spill_dir] — no spill_dir disables
+// spilling (pressure then fails creates with FULL, as before).
 //
 // Build: g++ -O2 -std=c++17 -pthread -o ray_tpu_store store.cpp -lrt
 
@@ -55,13 +65,15 @@ namespace {
 
 constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
                   OP_DELETE = 5, OP_CONTAINS = 6, OP_LIST = 7, OP_STATS = 8,
-                  OP_SHUTDOWN = 9, OP_SUBSCRIBE = 10, OP_ABORT = 11;
+                  OP_SHUTDOWN = 9, OP_SUBSCRIBE = 10, OP_ABORT = 11,
+                  OP_PIN = 12, OP_UNPIN = 13;
 constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_FULL = 3,
                   ST_TIMEOUT = 4, ST_ERR = 5, ST_EVICTED = 6;
 constexpr uint8_t EV_SEALED = 1, EV_EVICTED = 2;
 constexpr size_t ID_SIZE = 28;
 
 bool WriteExact(int fd, const void *buf, size_t n);
+bool ReadExact(int fd, void *buf, size_t n);
 
 struct ObjectEntry {
   std::string shm_name;
@@ -69,18 +81,21 @@ struct ObjectEntry {
   bool sealed = false;
   int64_t refcount = 0;  // client references; creator holds one until seal
   uint64_t lru_tick = 0;
+  bool spilled = false;      // bytes live in spill_path, not in shm
+  std::string spill_path;
 };
 
 class Store {
  public:
-  explicit Store(uint64_t capacity) : capacity_(capacity) {}
+  Store(uint64_t capacity, std::string spill_dir)
+      : capacity_(capacity), spill_dir_(std::move(spill_dir)) {}
 
   uint8_t Create(const std::string &id, uint64_t size, std::string *shm_name) {
     std::unique_lock<std::mutex> lk(mu_);
     if (closing_) return ST_ERR;  // shutting down: no new segments may appear
     if (objects_.count(id)) return ST_EXISTS;
     tombstones_.erase(id);  // reconstruction recreates an evicted object
-    if (used_ + size > capacity_ && !EvictLocked(size)) return ST_FULL;
+    if (!EnsureCapacityLocked(size)) return ST_FULL;
     std::string name = "/rt_store_" + std::to_string(getpid()) + "_" +
                        Hex(id.substr(0, 8)) + "_" + std::to_string(seq_++);
     int fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
@@ -112,12 +127,15 @@ class Store {
     objects_.erase(it);
   }
 
-  uint8_t Seal(const std::string &id) {
+  // pin=true converts the creator's reference into a long-lived pin
+  // ATOMICALLY with the seal — primary copies must never be evictable in
+  // the window before the raylet's async pin would land.
+  uint8_t Seal(const std::string &id, bool pin) {
     std::unique_lock<std::mutex> lk(mu_);
     auto it = objects_.find(id);
     if (it == objects_.end()) return ST_NOT_FOUND;
     it->second.sealed = true;
-    it->second.refcount--;  // drop creator ref; object now LRU-evictable at 0
+    if (!pin) it->second.refcount--;  // drop creator ref; LRU-evictable at 0
     it->second.lru_tick = tick_++;
     PushEventLocked(EV_SEALED, id);
     sealed_cv_.notify_all();
@@ -132,6 +150,7 @@ class Store {
     for (;;) {
       auto it = objects_.find(id);
       if (it != objects_.end() && it->second.sealed) {
+        if (it->second.spilled && !RestoreLocked(id, it->second)) return ST_ERR;
         it->second.refcount++;
         it->second.lru_tick = tick_++;
         *shm_name = it->second.shm_name;
@@ -156,13 +175,29 @@ class Store {
     return ST_OK;
   }
 
+  // Long-lived reference for primary copies (raylet-held); pinned objects
+  // are never LRU-evicted — under pressure they spill instead.
+  uint8_t Pin(const std::string &id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end() || !it->second.sealed) return ST_NOT_FOUND;
+    it->second.refcount++;
+    return ST_OK;
+  }
+
+  uint8_t Unpin(const std::string &id) { return Release(id); }
+
   uint8_t Delete(const std::string &id) {
     std::unique_lock<std::mutex> lk(mu_);
     auto it = objects_.find(id);
     if (it == objects_.end()) return ST_NOT_FOUND;
     // Unlink now; clients holding an mmap keep their pages until they unmap.
-    shm_unlink(it->second.shm_name.c_str());
-    used_ -= it->second.size;
+    if (it->second.spilled) {
+      unlink(it->second.spill_path.c_str());
+    } else {
+      shm_unlink(it->second.shm_name.c_str());
+      used_ -= it->second.size;
+    }
     objects_.erase(it);
     tombstones_.insert(id);
     PushEventLocked(EV_EVICTED, id);
@@ -198,7 +233,12 @@ class Store {
   void UnlinkAll() {
     std::unique_lock<std::mutex> lk(mu_);
     closing_ = true;
-    for (auto &kv : objects_) shm_unlink(kv.second.shm_name.c_str());
+    for (auto &kv : objects_) {
+      if (kv.second.spilled)
+        unlink(kv.second.spill_path.c_str());
+      else
+        shm_unlink(kv.second.shm_name.c_str());
+    }
     objects_.clear();
     used_ = 0;
   }
@@ -275,26 +315,115 @@ class Store {
     }
   }
 
-  // LRU-evict sealed refcount==0 objects until `needed` fits. Caller holds mu_.
-  bool EvictLocked(uint64_t needed) {
+  // Make room for `needed` bytes. Caller holds mu_. Policy (reference:
+  // eviction_policy.h LRU + local_object_manager.cc spill): first LRU-EVICT
+  // sealed, unreferenced, in-memory objects (recoverable via lineage or
+  // other copies); then SPILL referenced/pinned sealed objects to disk
+  // (restored on Get, never lost). IO runs under mu_ — a deliberate v1
+  // simplification; object churn is control-plane rate here.
+  bool EnsureCapacityLocked(uint64_t needed) {
     while (used_ + needed > capacity_) {
       std::string victim;
       uint64_t best_tick = UINT64_MAX;
       for (auto &kv : objects_) {
-        if (kv.second.sealed && kv.second.refcount == 0 &&
+        if (kv.second.sealed && !kv.second.spilled && kv.second.refcount == 0 &&
+            kv.second.size > 0 && kv.second.lru_tick < best_tick) {
+          best_tick = kv.second.lru_tick;
+          victim = kv.first;
+        }
+      }
+      if (!victim.empty()) {
+        auto it = objects_.find(victim);
+        shm_unlink(it->second.shm_name.c_str());
+        used_ -= it->second.size;
+        objects_.erase(it);
+        tombstones_.insert(victim);
+        PushEventLocked(EV_EVICTED, victim);
+        continue;
+      }
+      if (spill_dir_.empty()) return false;
+      // no evictable object: spill the LRU referenced in-memory object
+      best_tick = UINT64_MAX;
+      for (auto &kv : objects_) {
+        if (kv.second.sealed && !kv.second.spilled && kv.second.size > 0 &&
             kv.second.lru_tick < best_tick) {
           best_tick = kv.second.lru_tick;
           victim = kv.first;
         }
       }
       if (victim.empty()) return false;
-      auto it = objects_.find(victim);
-      shm_unlink(it->second.shm_name.c_str());
-      used_ -= it->second.size;
-      objects_.erase(it);
-      tombstones_.insert(victim);
-      PushEventLocked(EV_EVICTED, victim);
+      if (!SpillLocked(victim, objects_[victim])) return false;
     }
+    return true;
+  }
+
+  bool SpillLocked(const std::string &id, ObjectEntry &e) {
+    std::string path = spill_dir_ + "/" + Hex(id);
+    int sfd = shm_open(e.shm_name.c_str(), O_RDONLY, 0600);
+    if (sfd < 0) return false;
+    void *src = nullptr;
+    if (e.size > 0) {
+      src = mmap(nullptr, e.size, PROT_READ, MAP_SHARED, sfd, 0);
+      close(sfd);
+      if (src == MAP_FAILED) return false;
+    } else {
+      close(sfd);
+    }
+    int out = open(path.c_str(), O_CREAT | O_TRUNC | O_WRONLY, 0600);
+    if (out < 0) {
+      if (src) munmap(src, e.size);
+      return false;
+    }
+    bool ok = e.size == 0 || WriteExact(out, src, e.size);
+    close(out);
+    if (src) munmap(src, e.size);
+    if (!ok) {
+      unlink(path.c_str());
+      return false;
+    }
+    shm_unlink(e.shm_name.c_str());
+    e.spilled = true;
+    e.spill_path = path;
+    used_ -= e.size;
+    return true;
+  }
+
+  bool RestoreLocked(const std::string &id, ObjectEntry &e) {
+    if (closing_) return false;  // no new segments after UnlinkAll
+    if (!EnsureCapacityLocked(e.size)) return false;
+    std::string name = "/rt_store_" + std::to_string(getpid()) + "_" +
+                       Hex(id.substr(0, 8)) + "_" + std::to_string(seq_++);
+    int fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return false;
+    if (ftruncate(fd, (off_t)e.size) != 0) {
+      close(fd);
+      shm_unlink(name.c_str());
+      return false;
+    }
+    bool ok = true;
+    if (e.size > 0) {
+      void *dst = mmap(nullptr, e.size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+      close(fd);
+      if (dst == MAP_FAILED) {
+        shm_unlink(name.c_str());
+        return false;
+      }
+      int in = open(e.spill_path.c_str(), O_RDONLY);
+      ok = in >= 0 && ReadExact(in, dst, e.size);
+      if (in >= 0) close(in);
+      munmap(dst, e.size);
+    } else {
+      close(fd);
+    }
+    if (!ok) {
+      shm_unlink(name.c_str());
+      return false;
+    }
+    unlink(e.spill_path.c_str());
+    e.shm_name = name;
+    e.spilled = false;
+    e.spill_path.clear();
+    used_ += e.size;
     return true;
   }
 
@@ -313,6 +442,7 @@ class Store {
   std::unordered_map<std::string, ObjectEntry> objects_;
   std::unordered_set<std::string> tombstones_;
   uint64_t capacity_;
+  std::string spill_dir_;
   uint64_t used_ = 0;
   uint64_t tick_ = 0;
   uint64_t seq_ = 0;
@@ -396,7 +526,8 @@ void ServeClient(Store *store, int fd) {
         break;
       }
       case OP_SEAL: {
-        uint8_t st = store->Seal(id);
+        bool pin = payload_len >= 1 && payload[0] != 0;
+        uint8_t st = store->Seal(id, pin);
         if (st == ST_OK) unsealed.erase(id);
         SendResp(fd, st);
         break;
@@ -449,6 +580,12 @@ void ServeClient(Store *store, int fd) {
         unsealed.erase(id);
         SendResp(fd, ST_OK);
         break;
+      case OP_PIN:
+        SendResp(fd, store->Pin(id));
+        break;
+      case OP_UNPIN:
+        SendResp(fd, store->Unpin(id));
+        break;
       case OP_SUBSCRIBE:
         // Connection becomes a push-only event stream owned by the
         // notifier thread; stop reading requests and do NOT close the fd.
@@ -485,12 +622,19 @@ void HandleTerm(int) {
 
 int main(int argc, char **argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: %s <socket_path> <capacity_bytes>\n", argv[0]);
+    fprintf(stderr, "usage: %s <socket_path> <capacity_bytes> [spill_dir]\n",
+            argv[0]);
     return 1;
   }
   const char *sock_path = argv[1];
   uint64_t capacity = strtoull(argv[2], nullptr, 10);
-  Store store(capacity);
+  std::string spill_dir = argc > 3 ? argv[3] : "";
+  if (!spill_dir.empty() && mkdir(spill_dir.c_str(), 0700) != 0 &&
+      errno != EEXIST) {
+    fprintf(stderr, "cannot create spill dir %s\n", spill_dir.c_str());
+    spill_dir.clear();
+  }
+  Store store(capacity, spill_dir);
   g_store = &store;
   g_sock_path = sock_path;
   signal(SIGTERM, HandleTerm);
