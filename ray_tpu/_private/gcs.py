@@ -175,13 +175,18 @@ class GcsService:
                         info["alive"] = False
                         dead.append(node_id)
                 # sweep aged object-directory tombstones (getters that still
-                # care learned "evicted" long ago and reconstructed)
+                # care learned "evicted" long ago and reconstructed). PENDING
+                # frees (freed before any seal, not yet applied) are exempt:
+                # their marker must survive until the late seal arrives.
                 cutoff = now - self._dir_tombstone_ttl_s
                 expired = [
                     oid for oid, ts in self._dir_tombstone_ts.items()
                     if ts < cutoff
                 ]
                 for oid in expired:
+                    e = self.object_dir.get(oid)
+                    if e is not None and e.get("freed") and not e.get("free_applied"):
+                        continue
                     del self._dir_tombstone_ts[oid]
                     self.object_dir.pop(oid, None)
             for node_id in dead:
@@ -320,6 +325,8 @@ class GcsService:
                     if e.get("freed"):
                         # owner freed this object before it was ever sealed
                         # (fire-and-forget task result): free it now
+                        e["free_applied"] = True
+                        self._dir_tombstone_ts[oid] = now  # sweepable again
                         late_frees.append((nid, oid))
                 else:
                     if e is None:
@@ -350,8 +357,13 @@ class GcsService:
                 e = self.object_dir[oid] = {"nodes": set(), "evicted": False}
             e["freed"] = True
             holders = list(e["nodes"])
-            # freed entries are garbage: let the tombstone sweep reclaim them
-            self._dir_tombstone_ts.setdefault(oid, time.monotonic())
+            if holders:
+                # applied now: the entry may age out via the tombstone sweep
+                e["free_applied"] = True
+                self._dir_tombstone_ts.setdefault(oid, time.monotonic())
+            # else: PENDING free (result not sealed yet) — the sweep skips
+            # unapplied frees so a late seal still gets unpinned, however
+            # late (bounded by in-flight fire-and-forget tasks)
         for nid in holders:
             self._free_on_node(nid, oid)
         return {"ok": True}

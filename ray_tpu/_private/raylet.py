@@ -422,19 +422,23 @@ class Raylet:
                 self._dispatch_cv.notify_all()
 
     def rpc_free_object(self, conn, msgid, p):
-        """Owner's refs hit zero: unpin and drop the local copy (routed via
-        the GCS directory; reference: ReferenceCounter zero-ref → plasma
-        free, reference_count.h:61-115)."""
+        """Owner's refs hit zero: UNPIN the local copy so it becomes
+        LRU-evictable (routed via the GCS directory; reference:
+        ReferenceCounter zero-ref → plasma objects become evictable,
+        reference_count.h:61-115). Deliberately NOT an immediate delete:
+        the owner cannot see borrowers (refs deserialized elsewhere), so
+        reclamation happens lazily under memory pressure — a borrower of a
+        freed ref keeps working unless pressure evicts it first, and task
+        results remain lineage-reconstructible."""
         oid = p["object_id"]
         with self._lock:
             pinned = oid in self._pinned
             self._pinned.discard(oid)
-        try:
-            if pinned:
+        if pinned:
+            try:
                 self.store.unpin(ObjectID(oid))
-            self.store.delete(ObjectID(oid))
-        except Exception:  # noqa: BLE001 — store tearing down
-            pass
+            except Exception:  # noqa: BLE001 — store tearing down
+                pass
         return {"ok": True}
 
     # ------------- dependency resolution -------------

@@ -100,6 +100,10 @@ class CoreWorker:
         self.task_events = TaskEventBuffer(
             self.gcs, self.worker_id.hex(), node_id.hex()
         )
+        self._stopped = threading.Event()
+        threading.Thread(
+            target=self._dep_hold_sweep_loop, daemon=True, name="dep-hold-sweep"
+        ).start()
 
     # ---------------- notifications ----------------
 
@@ -173,6 +177,25 @@ class CoreWorker:
             self.gcs.call_async("free_object", {"object_id": oid})
         except Exception:  # noqa: BLE001 — shutting down
             pass
+
+    def _dep_hold_sweep_loop(self) -> None:
+        """Fire-and-forget tasks are never observed via get()/wait(); their
+        argument holds would pin objects forever. Lazily ask the directory
+        whether each held task's first return has ever been sealed (or
+        freed) and release the holds then."""
+        while not self._stopped.wait(5.0):
+            with self._ref_lock:
+                held = list(self._task_dep_holds)
+            for task_id in held:
+                oid = ObjectID.for_task_return(TaskID(task_id), 0)
+                try:
+                    r = self.gcs.call(
+                        "get_object_locations", {"object_id": oid.binary()}
+                    )
+                except Exception:  # noqa: BLE001 — GCS restarting
+                    break
+                if r.get("known"):
+                    self._release_task_dep_holds(task_id)
 
     # ---------------- object API ----------------
 
@@ -353,6 +376,9 @@ class CoreWorker:
                 if st == "present":
                     ready.append(r)
                     pending.remove(r)
+                    # observed completion releases the task's argument refs
+                    # (same as get(); fire-and-forget is swept lazily)
+                    self._release_task_dep_holds(r.object_id.task_id().binary())
                 elif do_fetch:
                     self._maybe_fetch(r.object_id, status=st)
             if len(ready) >= num_returns:
@@ -596,6 +622,7 @@ class CoreWorker:
     # ---------------- shutdown ----------------
 
     def shutdown(self) -> None:
+        self._stopped.set()
         self.task_events.stop()
         for c in self._actor_raylet_clients.values():
             c.close()

@@ -634,6 +634,16 @@ int main(int argc, char **argv) {
     fprintf(stderr, "cannot create spill dir %s\n", spill_dir.c_str());
     spill_dir.clear();
   }
+  if (!spill_dir.empty()) {
+    // per-daemon subdir: several stores may share one configured spill
+    // root (e.g. every node of a local cluster) and the same object id can
+    // exist in more than one store — files must never clobber across stores
+    spill_dir += "/pid" + std::to_string(getpid());
+    if (mkdir(spill_dir.c_str(), 0700) != 0 && errno != EEXIST) {
+      fprintf(stderr, "cannot create spill dir %s\n", spill_dir.c_str());
+      spill_dir.clear();
+    }
+  }
   Store store(capacity, spill_dir);
   g_store = &store;
   g_sock_path = sock_path;

@@ -169,10 +169,16 @@ def gpt_forward(
 ) -> jax.Array:
     """tokens [B, S] int32 → logits [B, S, vocab] (f32)."""
     B, S = tokens.shape
-    x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(cfg.dtype)[:S]
+    wte = params["wte"].astype(cfg.dtype)
     if mesh is not None:
-        # pin the post-gather activation layout; without this SPMD falls back
-        # to full rematerialization when wte is vocab/embed-sharded
+        # Gather from a vocab/embed-sharded table forces SPMD's last-resort
+        # full rematerialization (replicate + repartition per step). The
+        # lookup wants the table replicated anyway — say so EXPLICITLY, so
+        # the all-gather happens once where the partitioner can place it,
+        # and the gather itself partitions trivially along batch.
+        wte = with_logical_constraint(wte, (None, None), rules, mesh)
+    x = wte[tokens] + params["wpe"].astype(cfg.dtype)[:S]
+    if mesh is not None:
         x = with_logical_constraint(x, ("batch", "seq", "embed"), rules, mesh)
 
     def body(x, bp):

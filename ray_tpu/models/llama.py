@@ -225,7 +225,13 @@ def llama_forward(
 ):
     """tokens [B, S] int32 → logits [B, S, vocab] f32 (+ total MoE aux loss)."""
     B, S = tokens.shape
-    x = params["wte"].astype(cfg.dtype)[tokens]
+    wte = params["wte"].astype(cfg.dtype)
+    if mesh is not None:
+        # replicate the table for the token gather (see gpt.py: a gather
+        # from a vocab/embed-sharded table triggers SPMD's involuntary full
+        # rematerialization fallback every step)
+        wte = with_logical_constraint(wte, (None, None), rules, mesh)
+    x = wte[tokens]
     if mesh is not None:
         x = with_logical_constraint(x, ("batch", "seq", "embed"), rules, mesh)
     cos, sin = rope_cache(S, cfg.head_dim, cfg.rope_theta)

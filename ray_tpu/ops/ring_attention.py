@@ -109,7 +109,13 @@ def ring_attention_sharded(
     axes and sequence over the ring axis.
     """
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+
+    try:
+        from jax import shard_map  # jax >= 0.8 (check_rep became check_vma)
+        _rep_kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+        _rep_kw = {"check_rep": False}
 
     spec = P(batch_axes, None, axis_name, None)
     fn = shard_map(
@@ -117,6 +123,6 @@ def ring_attention_sharded(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        **_rep_kw,
     )
     return fn(q, k, v)

@@ -5,7 +5,7 @@ from ray_tpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
-from ray_tpu.train.session import get_context, report
+from ray_tpu.train.session import get_context, get_dataset_shard, report
 from ray_tpu.train.trainer import JaxTrainer, Result
 from ray_tpu.train.worker_group import WorkerGroup
 
@@ -20,5 +20,6 @@ __all__ = [
     "ScalingConfig",
     "WorkerGroup",
     "get_context",
+    "get_dataset_shard",
     "report",
 ]

@@ -25,6 +25,9 @@ class TrainContext:
     storage_path: str = ""
     trial_dir: str = ""
     experiment_config: dict = field(default_factory=dict)
+    # name -> this rank's DataIterator shard (reference: streaming_split
+    # outputs delivered to each train worker, data_parallel_trainer.py:59)
+    dataset_shards: dict = field(default_factory=dict)
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -107,3 +110,16 @@ def report(metrics: dict, *, checkpoint=None) -> None:
 
 def get_context() -> TrainContext:
     return get_session().context
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a trainer dataset, as a DataIterator
+    (reference: ray.train.get_dataset_shard / session.get_dataset_shard —
+    the consumer side of Dataset.streaming_split)."""
+    shards = get_session().context.dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard named {name!r}; trainer datasets: "
+            f"{sorted(shards)}"
+        )
+    return shards[name]

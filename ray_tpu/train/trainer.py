@@ -86,10 +86,9 @@ class JaxTrainer:
                     "train_loop_config": self.train_loop_config,
                     "attempt": attempt,
                     "datasets": sorted(self.datasets),
-                }
+                },
+                datasets=self.datasets,
             )
-            if self.datasets:
-                self._attach_datasets(group)
             group.run(self.train_loop, self.train_loop_config)
             cursors = [0] * len(group.workers)
             done = [False] * len(group.workers)
@@ -125,9 +124,3 @@ class JaxTrainer:
             metrics_history=history,
         )
 
-    def _attach_datasets(self, group: WorkerGroup) -> None:
-        """Split each dataset across workers (streaming_split analog);
-        shards are announced to each worker session via its context."""
-        # Datasets are iterables of batches in round 1; the data layer's
-        # Dataset.streaming_split handles real sharding.
-        pass

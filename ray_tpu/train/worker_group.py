@@ -98,7 +98,11 @@ class WorkerGroup:
         self.pg = None
         self.workers: list = []
 
-    def start(self, experiment_config: dict | None = None) -> None:
+    def start(
+        self,
+        experiment_config: dict | None = None,
+        datasets: dict | None = None,
+    ) -> None:
         n = self.scaling.num_workers
         bundles = [self.scaling.worker_resources() for _ in range(n)]
         self.pg = placement_group(bundles, strategy=self.scaling.placement_strategy)
@@ -108,6 +112,16 @@ class WorkerGroup:
                 f"cannot reserve {bundles} with strategy "
                 f"{self.scaling.placement_strategy}"
             )
+        # shard each dataset across the gang (reference: streaming_split,
+        # python/ray/data/dataset.py:1149; delivered per-worker like
+        # data_parallel_trainer.py:59's dataset ingestion)
+        shard_table: dict[str, list] = {}
+        if datasets:
+            # keep the source refs alive for the whole run: the group owns
+            # them so ref-counted freeing can't reclaim shard blocks mid-run
+            self._dataset_shards = shard_table
+            for name, ds in datasets.items():
+                shard_table[name] = _shard_dataset(ds, n)
         self.workers = []
         for rank in range(n):
             ctx = dict(
@@ -118,6 +132,9 @@ class WorkerGroup:
                 storage_path=self.storage_path,
                 trial_dir=f"{self.storage_path}/worker_{rank}",
                 experiment_config=experiment_config or {},
+                dataset_shards={
+                    name: shards[rank] for name, shards in shard_table.items()
+                },
             )
             w = TrainWorker.options(
                 num_cpus=0,  # resources come from the bundle
@@ -165,6 +182,22 @@ class WorkerGroup:
             except Exception:
                 pass
         self.workers = []
+
+
+def _shard_dataset(ds, n: int) -> list:
+    """Dataset -> n per-worker DataIterators; a DataIterator is replicated
+    (the caller pre-sharded); anything else is rejected."""
+    from ray_tpu.data.dataset import Dataset
+    from ray_tpu.data.iterator import DataIterator
+
+    if isinstance(ds, Dataset):
+        return ds.streaming_split(n, equal=True)
+    if isinstance(ds, DataIterator):
+        return [ds] * n
+    raise TypeError(
+        f"trainer datasets must be ray_tpu.data Datasets or DataIterators, "
+        f"got {type(ds).__name__}"
+    )
 
 
 def _free_port() -> int:

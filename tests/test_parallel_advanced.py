@@ -145,3 +145,29 @@ def test_moe_expert_parallel_sharded(jax_cpu):
     np.testing.assert_allclose(
         np.asarray(y_sharded), np.asarray(y_unsharded), rtol=1e-4, atol=1e-5
     )
+
+
+def test_multichip_dryrun_compiles_without_spmd_remat():
+    """The full dryrun (dp/fsdp/tp, ring-attention sp, pp, ep) must compile
+    with ZERO '[SPMD] Involuntary full rematerialization' warnings — those
+    mean replicate-then-repartition traffic on every step (VERDICT r2 #6).
+    Subprocess: the dryrun needs its own 8-device CPU backend."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "Involuntary full rematerialization" not in r.stderr, (
+        "SPMD partitioner fell back to full remat:\n"
+        + "\n".join(
+            l for l in r.stderr.splitlines() if "Involuntary" in l
+        )[:2000]
+    )
