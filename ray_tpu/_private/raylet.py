@@ -593,15 +593,21 @@ class Raylet:
         aid = handle.actor_id
         with self._lock:
             actor = self._actors.get(aid)
-        if actor is None:
-            return
-        if spec is not None:
-            self._seal_error(spec, ActorDiedError(aid.hex(), "worker process died"))
-        with self._lock:
-            # the in-flight method died with the worker; allow the restarted
-            # instance to pump the remaining queue
-            actor["executing"] = False
+            if actor is None:
+                return
+            # snapshot + reset ATOMICALLY: a racing _pump_actor either ran
+            # before (its spec is in the snapshot and gets sealed; its
+            # failed notify finds the inflight entry gone and skips the
+            # requeue) or runs after and sees worker=None
+            inflight = list(actor["inflight"].values())
+            actor["inflight"].clear()
+            actor["executing"] = 0
             actor["worker"] = None
+        if spec is not None and spec["type"] == ts.ACTOR_CREATION:
+            self._seal_error(spec, ActorDiedError(aid.hex(), "worker process died"))
+        for fspec in inflight:
+            # every method in flight died with the worker
+            self._seal_error(fspec, ActorDiedError(aid.hex(), "worker process died"))
         creation_spec = actor["creation_spec"]
         if actor["num_restarts"] < creation_spec.get("max_restarts", 0):
             actor["num_restarts"] += 1
@@ -879,7 +885,12 @@ class Raylet:
                 "state": "STARTING",
                 "creation_spec": spec,
                 "queue": [],
-                "executing": False,
+                # up to max_concurrency methods run at once on the worker's
+                # thread pool (reference: concurrency_group_manager.cc /
+                # threaded actors); in-flight specs tracked for death sealing
+                "max_concurrency": max(1, int(spec.get("max_concurrency", 1))),
+                "executing": 0,
+                "inflight": {},  # task_id -> spec
                 "worker": None,
                 "num_restarts": 0,
                 "assignment": assignment,
@@ -932,40 +943,48 @@ class Raylet:
         threading.Thread(target=finish_registration, daemon=True).start()
 
     def _pump_actor(self, aid: bytes) -> None:
-        """Run next queued method if the actor is idle (in-order by seqno —
-        reference: actor_scheduling_queue.cc sequential ordering)."""
-        with self._lock:
-            actor = self._actors.get(aid)
-            if (
-                actor is None
-                or actor["state"] != "ALIVE"
-                or actor["executing"]
-                or not actor["queue"]
-            ):
-                return
-            if actor["worker"] is None or actor["worker"].conn is None:
-                return  # restarting; rpc_actor_started will pump
-            seqno, _tie, spec = heapq.heappop(actor["queue"])
-            actor["executing"] = True
-            handle = actor["worker"]
-            handle.current_task = spec
-        if not handle.conn.notify(
-            "execute_task", {"spec": spec, "chips": handle.assigned_chips}
-        ):
-            # Dead connection: requeue the method, mark idle, and let the
-            # disconnect path (or an already-started restart) re-pump; retry
-            # shortly in case actor_started raced ahead of this requeue.
+        """Dispatch queued methods while capacity allows: strictly in seqno
+        order (reference: actor_scheduling_queue.cc sequential ordering),
+        up to max_concurrency in flight at once (threaded-actor semantics —
+        ordering of EXECUTION is lost beyond 1, as in the reference)."""
+        while True:
             with self._lock:
-                actor["executing"] = False
-                handle.current_task = None
-                self._actor_seq += 1
-                heapq.heappush(actor["queue"], (seqno, self._actor_seq, spec))
+                actor = self._actors.get(aid)
+                if (
+                    actor is None
+                    or actor["state"] != "ALIVE"
+                    or actor["executing"] >= actor["max_concurrency"]
+                    or not actor["queue"]
+                ):
+                    return
+                if actor["worker"] is None or actor["worker"].conn is None:
+                    return  # restarting; rpc_actor_started will pump
+                seqno, _tie, spec = heapq.heappop(actor["queue"])
+                actor["executing"] += 1
+                actor["inflight"][spec["task_id"]] = spec
+                handle = actor["worker"]
+            if not handle.conn.notify(
+                "execute_task", {"spec": spec, "chips": handle.assigned_chips}
+            ):
+                # Dead connection: requeue the method and let the disconnect
+                # path (or an already-started restart) re-pump; retry shortly
+                # in case actor_started raced ahead of this requeue. If the
+                # death handler already swept this spec out of inflight it
+                # was sealed with ActorDiedError — do NOT also requeue.
+                with self._lock:
+                    if actor["inflight"].pop(spec["task_id"], None) is not None:
+                        actor["executing"] = max(0, actor["executing"] - 1)
+                        self._actor_seq += 1
+                        heapq.heappush(
+                            actor["queue"], (seqno, self._actor_seq, spec)
+                        )
 
-            def _retry():
-                time.sleep(0.1)
-                self._pump_actor(aid)
+                def _retry():
+                    time.sleep(0.1)
+                    self._pump_actor(aid)
 
-            threading.Thread(target=_retry, daemon=True).start()
+                threading.Thread(target=_retry, daemon=True).start()
+                return
 
     def rpc_actor_started(self, conn, msgid, p):
         """Worker reports actor __init__ finished."""
@@ -1037,7 +1056,12 @@ class Raylet:
                 handle.current_task = None
                 actor = self._actors.get(aid)
                 if actor is not None:
-                    actor["executing"] = False
+                    tid = p.get("task_id") if isinstance(p, dict) else None
+                    # only a task we actually dispatched occupies a slot —
+                    # the actor-creation task's task_done must NOT decrement
+                    # (it never went through _pump_actor)
+                    if tid is not None and actor["inflight"].pop(tid, None) is not None:
+                        actor["executing"] = max(0, actor["executing"] - 1)
             self._pump_actor(aid)
         else:
             self._release_task_resources(handle)

@@ -52,6 +52,7 @@ def make_task_spec(
     scheduling: dict | None = None,
     runtime_env: dict | None = None,
     max_restarts: int = 0,
+    max_concurrency: int = 1,
     owner_address: str = "",
 ) -> dict:
     from ray_tpu._private.object_ref import ObjectRef  # circular import
@@ -93,6 +94,7 @@ def make_task_spec(
         "scheduling": scheduling or {"type": SCHED_DEFAULT},
         "runtime_env": runtime_env,
         "max_restarts": max_restarts,
+        "max_concurrency": max_concurrency,
         "owner_address": owner_address,
     }
 

@@ -489,9 +489,19 @@ class CoreWorker:
 
     # ---------------- task execution (worker mode) ----------------
 
+    # method thread pool for max_concurrency > 1 actors (reference:
+    # threaded actors via concurrency_group_manager.cc); created at
+    # actor creation, None for ordinary serial actors
+    _method_pool = None
+
     def execute_task(self, spec: dict, chips: list[int]) -> None:
         """Run one task and seal its results. Called on the worker's
         execution thread (reference: _raylet.pyx:1457 execute_task)."""
+        if spec["type"] == ts.ACTOR_TASK and self._method_pool is not None:
+            # concurrent actor: methods overlap on the pool; shared task
+            # context (task_id, chips env) stays that of the creation task
+            self._method_pool.submit(self._execute_actor_method_concurrent, spec)
+            return
         os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
         os.environ["RT_TASK_RESOURCES"] = repr(spec["resources"])
         prev_task = self.task_id
@@ -523,7 +533,7 @@ class CoreWorker:
                 task_type=spec["type"],
             )
             self.task_id = prev_task
-            self.raylet.call("task_done", {})
+            self.raylet.call("task_done", {"task_id": spec["task_id"]})
 
     def _resolve_args(self, spec: dict) -> tuple[tuple, dict]:
         args, kwargs = ser.deserialize(spec["args_blob"])
@@ -592,6 +602,13 @@ class CoreWorker:
             args, kwargs = self._resolve_args(spec)
             self.actor_instance = cls(*args, **kwargs)
             self.current_actor_id = ActorID(spec["actor_id"])
+            n = int(spec.get("max_concurrency", 1) or 1)
+            if n > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._method_pool = ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix="actor-method"
+                )
             self._store_returns(spec, None)
             self.raylet.call(
                 "actor_started",
@@ -618,6 +635,32 @@ class CoreWorker:
             self._store_returns(spec, result)
         except Exception as e:  # noqa: BLE001
             self._store_error(spec, e)
+
+    def _execute_actor_method_concurrent(self, spec: dict) -> None:
+        """One method on the concurrency pool. Self-contained: no shared
+        task-context mutation (other methods are running), its own events,
+        its own task_done."""
+        self.task_events.record(
+            task_id=spec["task_id"], job_id=spec["job_id"], name=spec["name"],
+            event="RUNNING", task_type=spec["type"],
+        )
+        failed = False
+        try:
+            method = getattr(self.actor_instance, spec["method_name"])
+            args, kwargs = self._resolve_args(spec)
+            result = method(*args, **kwargs)
+            self._store_returns(spec, result)
+        except Exception as e:  # noqa: BLE001 — user code may raise anything
+            failed = True
+            self._store_error(spec, e)
+        self.task_events.record(
+            task_id=spec["task_id"], job_id=spec["job_id"], name=spec["name"],
+            event="FAILED" if failed else "FINISHED", task_type=spec["type"],
+        )
+        try:
+            self.raylet.call("task_done", {"task_id": spec["task_id"]})
+        except Exception:  # noqa: BLE001 — raylet shutting down
+            pass
 
     # ---------------- shutdown ----------------
 

@@ -35,6 +35,7 @@ class ActorClass:
 
         validate_runtime_env(runtime_env)
         self._runtime_env = runtime_env
+        self._max_concurrency = max(1, int(max_concurrency))
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -53,9 +54,11 @@ class ActorClass:
         if "resources" in opts:
             res.update(opts["resources"])
         clone._resources = res
-        for key in ("max_restarts", "name", "lifetime", "scheduling_strategy", "runtime_env"):
+        for key in ("max_restarts", "name", "lifetime", "scheduling_strategy",
+                    "runtime_env", "max_concurrency"):
             if key in opts:
                 setattr(clone, "_" + key, opts[key])
+        clone._max_concurrency = max(1, int(clone._max_concurrency))
         if "runtime_env" in opts:
             from ray_tpu._private.runtime_env import validate_runtime_env
 
@@ -92,6 +95,7 @@ class ActorClass:
             placement=placement,
             scheduling=scheduling,
             runtime_env=self._runtime_env,
+            max_concurrency=self._max_concurrency,
         )
         worker.submit_task(spec)
         return ActorHandle(actor_id, self._class_name)

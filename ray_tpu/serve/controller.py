@@ -386,6 +386,12 @@ class ServeController:
         opts = dict(ds.config.ray_actor_options)
         num_cpus = opts.pop("num_cpus", 1)
         num_tpus = opts.pop("num_tpus", 0)
+        # replicas serve up to max_ongoing_requests concurrently on the
+        # worker's method pool (reference: replicas are async actors bounded
+        # by max_ongoing_requests) — overridable via ray_actor_options
+        max_concurrency = int(
+            opts.pop("max_concurrency", 0) or ds.config.max_ongoing_requests or 1
+        )
         resources = dict(opts.pop("resources", None) or {})
         # remaining numeric keys are custom resources ({"TPU": 1} rides here
         # per DeploymentConfig's contract) — never drop them silently
@@ -404,6 +410,7 @@ class ServeController:
             num_tpus=num_tpus,
             resources=resources or None,
             max_restarts=0,  # the reconciler owns restarts, not the raylet
+            max_concurrency=max_concurrency,
         )
         handle = actor_cls.remote(
             spec["name"],

@@ -40,9 +40,11 @@ def _resolve_handle_args(value):
 
 class ReplicaActor:
     """One serving replica. Created by the controller with the serialized
-    user callable; methods are invoked by routers via rt_call /
-    rt_batched (ordered actor tasks — one at a time, which is the right
-    default for a TPU-bound model: the chip runs one program anyway)."""
+    user callable; methods are invoked by routers via rt_call / rt_batched.
+    The replica actor runs up to max_ongoing_requests methods concurrently
+    on its worker's method pool (reference: async replicas bounded by
+    max_ongoing_requests), so I/O-bound callables overlap; a TPU-bound
+    model still serializes on the chip itself."""
 
     def __init__(
         self,
