@@ -7,7 +7,14 @@ data parallelism via sharded batches); weights sync device→host once per
 iteration.
 """
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.algorithms import DQN, DQNConfig, PPO, PPOConfig
+from ray_tpu.rllib.algorithms import (
+    DQN,
+    DQNConfig,
+    IMPALA,
+    ImpalaConfig,
+    PPO,
+    PPOConfig,
+)
 from ray_tpu.rllib.env import (
     CartPole,
     Corridor,
@@ -33,6 +40,8 @@ __all__ = [
     "Env",
     "EnvRunner",
     "GymEnv",
+    "IMPALA",
+    "ImpalaConfig",
     "Learner",
     "PPO",
     "PPOConfig",

@@ -162,6 +162,12 @@ class Algorithm:
                 timeout=120,
             )
 
+    def _record_batch(self, b: dict) -> None:
+        """Episode-return window + lifetime step accounting for one batch."""
+        self._recent_returns.extend(b["episode_returns"].tolist())
+        self._recent_returns = self._recent_returns[-100:]
+        self._total_env_steps += b["rewards"].size
+
     def _sample_all(self) -> list[dict]:
         """synchronous_parallel_sample (reference: rollout_ops.py:21)."""
         if self._local_runner is not None:
@@ -173,9 +179,7 @@ class Algorithm:
                 [r.sample.remote() for r in self._runners], timeout=300
             )
         for b in batches:
-            self._recent_returns.extend(b["episode_returns"].tolist())
-            self._total_env_steps += b["rewards"].size
-        self._recent_returns = self._recent_returns[-100:]
+            self._record_batch(b)
         return batches
 
     # -- public Trainable surface --

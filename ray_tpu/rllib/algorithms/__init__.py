@@ -6,3 +6,7 @@ from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ += ["A2C", "A2CConfig", "SAC", "SACConfig"]
+
+from ray_tpu.rllib.algorithms.impala import IMPALA, ImpalaConfig
+
+__all__ += ["IMPALA", "ImpalaConfig"]

@@ -1,0 +1,206 @@
+"""IMPALA — asynchronous sampling with a V-trace off-policy learner.
+
+Equivalent of the reference's IMPALA (reference: rllib/algorithms/impala/
+impala.py — actors sample continuously with stale weights; the learner
+consumes batches as they arrive and corrects off-policyness with V-trace,
+Espeholt et al. 2018). TPU mapping: the V-trace recursion runs IN-GRAPH as
+a reverse lax.scan inside the jitted learner step (static [T, E] shapes),
+instead of the reference's torch host-side loop; env runners stay CPU
+actors and are never blocked on the learner — each runner always has one
+sample() in flight, and weight broadcasts are fire-and-forget.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.rl_module import ActorCriticModule
+
+
+def vtrace_reference_np(
+    behavior_logp, target_logp, rewards, values, last_values,
+    dones, terminateds, bootstrap_values, gamma,
+    rho_max=1.0, c_max=1.0,
+):
+    """Plain-numpy V-trace oracle (loop form) used by the tests to pin the
+    jitted scan implementation."""
+    T, E = rewards.shape
+    rhos = np.minimum(np.exp(target_logp - behavior_logp), rho_max)
+    cs = np.minimum(np.exp(target_logp - behavior_logp), c_max)
+    not_term = 1.0 - terminateds.astype(np.float32)
+    not_done = 1.0 - dones.astype(np.float32)
+    # successor value per step: next row's V, the true-final-obs bootstrap at
+    # truncations, masked to 0 at terminations
+    v_next = np.empty((T, E), np.float32)
+    v_next[:-1] = values[1:]
+    v_next[-1] = last_values
+    v_next = np.where(dones, bootstrap_values, v_next)
+    acc = np.zeros(E, np.float32)
+    vs = np.empty((T, E), np.float32)
+    for t in range(T - 1, -1, -1):
+        delta = rhos[t] * (rewards[t] + gamma * not_term[t] * v_next[t] - values[t])
+        acc = delta + gamma * cs[t] * not_done[t] * acc
+        vs[t] = values[t] + acc
+    vs_next = np.empty((T, E), np.float32)
+    vs_next[:-1] = vs[1:]
+    vs_next[-1] = last_values
+    vs_next = np.where(dones, bootstrap_values, vs_next)
+    pg_adv = rhos * (rewards + gamma * not_term * vs_next - values)
+    return vs, pg_adv
+
+
+def impala_loss(module, params, batch, config):
+    """V-trace actor-critic loss, fully in-graph (reverse lax.scan)."""
+    import jax
+    import jax.numpy as jnp
+
+    T, E = batch["rewards"].shape
+    obs = batch["obs"].reshape(T * E, -1)
+    logits, values = module.forward(params, obs)
+    logits = logits.reshape(T, E, -1)
+    values = values.reshape(T, E)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+
+    gamma = config["gamma"]
+    rhos_raw = jnp.exp(jax.lax.stop_gradient(logp) - batch["behavior_logp"])
+    rhos = jnp.minimum(rhos_raw, config["rho_max"])
+    cs = jnp.minimum(rhos_raw, config["c_max"])
+    not_term = 1.0 - batch["terminateds"].astype(jnp.float32)
+    not_done = 1.0 - batch["dones"].astype(jnp.float32)
+    values_sg = jax.lax.stop_gradient(values)
+    v_next = jnp.concatenate(
+        [values_sg[1:], batch["last_values"][None]], axis=0
+    )
+    v_next = jnp.where(batch["dones"], batch["bootstrap_values"], v_next)
+
+    delta = rhos * (batch["rewards"] + gamma * not_term * v_next - values_sg)
+
+    def scan_fn(acc, xs):
+        d, c, nd = xs
+        acc = d + gamma * c * nd * acc
+        return acc, acc
+
+    _, acc_seq = jax.lax.scan(
+        scan_fn, jnp.zeros(E, jnp.float32), (delta, cs, not_done), reverse=True
+    )
+    vs = values_sg + acc_seq
+    vs_next = jnp.concatenate([vs[1:], batch["last_values"][None]], axis=0)
+    vs_next = jnp.where(batch["dones"], batch["bootstrap_values"], vs_next)
+    pg_adv = rhos * (batch["rewards"] + gamma * not_term * vs_next - values_sg)
+
+    policy_loss = -jnp.mean(logp * pg_adv)
+    value_loss = jnp.mean(jnp.square(values - vs))
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = (
+        policy_loss
+        + config["vf_loss_coeff"] * value_loss
+        - config["entropy_coeff"] * entropy
+    )
+    metrics = {
+        "policy_loss": policy_loss,
+        "vf_loss": value_loss,
+        "entropy": entropy,
+        "mean_rho": jnp.mean(rhos_raw),
+    }
+    return total, metrics
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.vtrace_rho_clip = 1.0
+        self.vtrace_c_clip = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.max_sample_staleness_s = 300.0
+        self.algo_class = IMPALA
+
+
+class IMPALA(Algorithm):
+    runner_mode = "actor_critic"
+
+    def _runner_factory(self):
+        hidden = tuple(self.config.hidden)
+        return lambda obs_dim, n_act: ActorCriticModule(obs_dim, n_act, hidden)
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        module = ActorCriticModule(self.obs_dim, self.num_actions, cfg.hidden)
+        self.learner = Learner(
+            module,
+            impala_loss,
+            config={
+                "gamma": cfg.gamma,
+                "rho_max": cfg.vtrace_rho_clip,
+                "c_max": cfg.vtrace_c_clip,
+                "vf_loss_coeff": cfg.vf_loss_coeff,
+                "entropy_coeff": cfg.entropy_coeff,
+            },
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self._inflight: dict = {}  # sample ref -> runner handle
+        self._broadcast_weights(self.learner.get_weights_np())
+
+    def _collect_async(self) -> list[dict]:
+        """Grab every finished rollout; resubmit sampling immediately so
+        runners are NEVER blocked on the learner (the IMPALA architecture;
+        reference actors likewise push batches into a learner queue)."""
+        import ray_tpu
+
+        if not self._inflight:
+            self._inflight = {r.sample.remote(): r for r in self._runners}
+        # block for at least one batch, then drain whatever else is ready
+        ready, _ = ray_tpu.wait(
+            list(self._inflight), num_returns=1,
+            timeout=self.config.max_sample_staleness_s,
+        )
+        more, _ = ray_tpu.wait(
+            [r for r in self._inflight if r not in ready],
+            num_returns=len(self._inflight) - len(ready),
+            timeout=0,
+        )
+        batches = []
+        for ref in list(ready) + list(more):
+            runner = self._inflight.pop(ref)
+            b = ray_tpu.get(ref, timeout=60)
+            self._record_batch(b)
+            batches.append(b)
+            self._inflight[runner.sample.remote()] = runner  # keep it busy
+        return batches
+
+    def training_step(self) -> dict:
+        if self._local_runner is not None:
+            batches = self._sample_all()
+        else:
+            batches = self._collect_async()
+        metrics_acc: dict[str, list[float]] = {}
+        for b in batches:
+            train = {
+                "obs": b["obs"],
+                "actions": b["actions"].astype(np.int32),
+                "behavior_logp": b["logp"],
+                "rewards": b["rewards"],
+                "dones": b["dones"],
+                "terminateds": b["terminateds"],
+                "bootstrap_values": b["bootstrap_values"],
+                "last_values": b["last_values"],
+            }
+            m = self.learner.update(train)
+            for k, v in m.items():
+                metrics_acc.setdefault(k, []).append(v)
+        # fire-and-forget broadcast: samplers pick the fresh weights up
+        # between rollouts; staleness is corrected by V-trace
+        w = self.learner.get_weights_np()
+        if self._local_runner is not None:
+            self._local_runner.set_weights(w)
+        else:
+            for r in self._runners:
+                r.set_weights.remote(w)
+        metrics = {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+        metrics["num_batches_consumed"] = len(batches)
+        return metrics

@@ -13,6 +13,7 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    SuggestAdapter,
     BasicVariantGenerator,
     Searcher,
     choice,
@@ -33,6 +34,7 @@ from ray_tpu.tune.tuner import (
 )
 
 __all__ = [
+    "SuggestAdapter",
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
     "FIFOScheduler",

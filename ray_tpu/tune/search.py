@@ -130,6 +130,54 @@ class Searcher:
         pass
 
 
+class SuggestAdapter(Searcher):
+    """Bridge an EXTERNAL suggest/observe optimizer into tune — the
+    Optuna/HyperOpt adapter pattern (reference:
+    tune/search/optuna/optuna_search.py: `ask()` at suggest time, `tell()`
+    at completion). The wrapped optimizer needs two methods:
+
+        ask() -> dict | None            # next config (None = budget spent)
+        tell(config, value) -> None     # observe an outcome; value is
+                                        # normalized so HIGHER IS BETTER
+                                        # (None for failed trials)
+
+    max_trials bounds the sweep when the optimizer itself is unbounded.
+    """
+
+    def __init__(self, optimizer: Any, *, max_trials: int | None = None):
+        self._opt = optimizer
+        self._max_trials = max_trials
+        self._suggested = 0
+        self._live: dict[str, dict] = {}  # trial_id -> config
+        self.metric: str | None = None
+        self.mode: str | None = None
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._max_trials is not None and self._suggested >= self._max_trials:
+            return None
+        cfg = self._opt.ask()
+        if cfg is None:
+            return None
+        self._suggested += 1
+        self._live[trial_id] = dict(cfg)
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None:
+            return
+        value = None
+        if not error and result is not None and self.metric in result:
+            value = float(result[self.metric])
+            if self.mode == "min":
+                value = -value  # adapter contract: higher is better
+        try:
+            self._opt.tell(cfg, value)
+        except Exception:  # noqa: BLE001 — a broken external optimizer must
+            pass  #                         not take down the experiment
+
+
 class BasicVariantGenerator(Searcher):
     """Grid x random expansion: the cross-product of all grid_search values,
     repeated num_samples times with random domains re-sampled per repeat."""

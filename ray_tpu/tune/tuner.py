@@ -149,7 +149,13 @@ class Tuner:
                 raise FileNotFoundError(
                     f"no experiment state at {self._restore_from}"
                 )
-            if self.param_space:
+            if tc.search_alg is not None:
+                # external searchers are stateful/stochastic: re-suggesting
+                # for restored trials would pair fresh ask() configs with old
+                # trials' results and corrupt the optimizer's history — only
+                # finish the already-materialized trials
+                controller._searcher_done = True
+            elif self.param_space:
                 # deterministic searcher (same param_space + seed): fast-forward
                 # past the suggestions already materialized as trials, then keep
                 # generating the remaining samples
