@@ -16,6 +16,7 @@ from typing import Any, Sequence
 from ray_tpu._private import worker as _worker_mod
 from ray_tpu._private.config import reset_config
 from ray_tpu._private.ids import JobID, NodeID
+from ray_tpu._private.generator import ObjectRefGenerator
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.worker import CoreWorker, global_worker, set_global_worker
 from ray_tpu.actor import ActorHandle, get_actor, kill
@@ -204,6 +205,7 @@ __all__ = [
     "available_resources",
     "nodes",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "exceptions",
 ]

@@ -34,6 +34,11 @@ class Config:
     # costs seconds on a busy host
     kill_idle_workers_interval_ms: int = 5_000
     idle_worker_killing_time_threshold_ms: int = 300_000
+    # OOM protection (reference: memory_monitor.h + worker_killing_policy):
+    # above the usage threshold the raylet kills task workers, retriable
+    # and newest first. 0 disables the monitor.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 250
     # --- GCS ---
     gcs_heartbeat_interval_ms: int = 1000
     health_check_failure_threshold: int = 5

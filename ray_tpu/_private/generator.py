@@ -1,0 +1,70 @@
+"""Streaming generator returns: refs yielded as the producer produces them.
+
+Equivalent of the reference's streaming ObjectRefGenerator
+(reference: python/ray/_raylet.pyx:957-1043 — num_returns="streaming" tasks
+yield; each yielded value becomes its own return object the consumer can
+get before the task finishes). Protocol here: the task's return index 0 is
+the COMPLETION MARKER (sealed last, holding the yield count — or the error
+payload), and yielded values seal at indices 1..n as they are produced, so
+the consumer streams by polling value presence and finishes/raises via the
+marker.
+"""
+from __future__ import annotations
+
+import time
+
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs for one streaming task. Yields each value's
+    ref as soon as the producer seals it; raises the task's error (from the
+    completion marker) and stops after `count` values."""
+
+    def __init__(self, completed_ref: ObjectRef, spec: dict):
+        self._completed_ref = completed_ref
+        self._task_id = TaskID(spec["task_id"])
+        self._spec = spec
+        self._i = 1
+        self._count: int | None = None
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        if self._count is not None and self._i > self._count:
+            raise StopIteration
+        oid_i = ObjectID.for_task_return(self._task_id, self._i)
+        while self._count is None:
+            # value already produced? stream it out eagerly
+            st = w.store.status(oid_i)
+            if st == "present":
+                break
+            w._maybe_fetch(oid_i, status=st)
+            # completion marker sealed? (also carries producer errors)
+            st0 = w.store.status(self._completed_ref.object_id)
+            if st0 == "present":
+                self._count = int(w.get(self._completed_ref))  # raises errors
+                if self._i > self._count:
+                    raise StopIteration
+                break
+            w._maybe_fetch(self._completed_ref.object_id, status=st0)
+            time.sleep(0.01)
+        ref = ObjectRef(oid_i)
+        # the consumer now owns this value like any task return: lineage for
+        # reconstruction, ownership for zero-ref freeing
+        with w._task_lock:
+            w._lineage[oid_i.binary()] = self._spec
+        with w._ref_lock:
+            w._owned.add(oid_i.binary())
+        self._i += 1
+        return ref
+
+    @property
+    def completed_ref(self) -> ObjectRef:
+        """Ref of the completion marker (count; raises the task's error)."""
+        return self._completed_ref

@@ -54,7 +54,10 @@ class WorkerHandle:
         self.is_actor_worker = False
         self.actor_id: bytes | None = None
         self.last_idle = time.monotonic()
+        self.task_started = 0.0  # dispatch time of current_task
         self.assigned_chips: list[int] = []
+        # memory-monitor kill attribution: (reason, task_id it was running)
+        self.oom_killed: tuple[str, bytes] | None = None
 
 
 class Raylet:
@@ -136,6 +139,7 @@ class Raylet:
             threading.Thread(target=self._dispatch_loop, daemon=True, name="raylet-dispatch"),
             threading.Thread(target=self._dir_flush_loop, daemon=True, name="raylet-objdir"),
             threading.Thread(target=self._idle_reaper_loop, daemon=True, name="raylet-reaper"),
+            threading.Thread(target=self._memory_monitor_loop, daemon=True, name="raylet-oom"),
         ]
         for t in self._threads:
             t.start()
@@ -243,6 +247,60 @@ class Raylet:
                         w.proc.terminate()
                 except Exception:  # noqa: BLE001
                     pass
+
+    def _memory_monitor_loop(self) -> None:
+        """Kill workers under memory pressure instead of letting the kernel
+        OOM-killer take down the raylet (reference: memory_monitor.h:52 +
+        worker_killing_policy.cc:116 — retriable tasks first, newest
+        first)."""
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+
+        cfg = global_config()
+        if cfg.memory_usage_threshold <= 0:
+            return
+        monitor = MemoryMonitor(cfg.memory_usage_threshold)
+        self._memory_monitor = monitor  # tests may swap the read function
+        interval = cfg.memory_monitor_refresh_ms / 1000.0
+        while not self._stopped.wait(interval):
+            try:
+                if not monitor.is_over_threshold():
+                    continue
+                frac = monitor.usage_fraction()
+                victim = self._pick_oom_victim(
+                    f"worker killed by the memory monitor: node memory usage "
+                    f"{frac:.0%} > threshold {cfg.memory_usage_threshold:.0%}"
+                )
+                if victim is None:
+                    continue
+                if victim.proc is not None:
+                    victim.proc.terminate()
+                elif victim.conn is not None:
+                    victim.conn.close()
+            except Exception:  # noqa: BLE001 — monitoring must never die
+                pass
+
+    def _pick_oom_victim(self, reason: str) -> WorkerHandle | None:
+        """Policy (reference: worker_killing_policy.cc retriable-LIFO):
+        among busy TASK workers prefer one whose task can retry, NEWEST
+        dispatch first (least progress lost); actor workers are spared
+        (they carry state). Selection and kill-attribution are marked under
+        the lock so a task that finishes before terminate() lands is not
+        mislabeled as OOM-killed."""
+        with self._lock:
+            busy = [
+                w for w in self._all_workers.values()
+                if not w.is_actor_worker and w.current_task is not None
+            ]
+            if not busy:
+                return None
+            retriable = [
+                w for w in busy
+                if w.current_task["retry_count"] < w.current_task["max_retries"]
+            ]
+            pool = retriable or busy
+            victim = max(pool, key=lambda w: w.task_started)
+            victim.oom_killed = (reason, victim.current_task["task_id"])
+            return victim
 
     # ------------- inter-node object plane -------------
 
@@ -563,9 +621,20 @@ class Raylet:
         else:
             self._release_task_resources(handle)
             if spec is not None:
-                self._on_task_worker_death(spec)
+                oom_reason = None
+                if (
+                    handle.oom_killed is not None
+                    and handle.oom_killed[1] == spec["task_id"]
+                ):
+                    # attribute the kill only to the task the monitor saw;
+                    # a task that finished in the selection→terminate window
+                    # dies as an ordinary worker crash instead
+                    oom_reason = handle.oom_killed[0]
+                self._on_task_worker_death(spec, oom_reason=oom_reason)
 
-    def _on_task_worker_death(self, spec: dict) -> None:
+    def _on_task_worker_death(self, spec: dict, oom_reason: str | None = None) -> None:
+        from ray_tpu.exceptions import OutOfMemoryError
+
         if spec["retry_count"] < spec["max_retries"]:
             spec = dict(spec, retry_count=spec["retry_count"] + 1)
             delay = global_config().task_retry_delay_ms / 1000.0
@@ -580,6 +649,14 @@ class Raylet:
             # backoff before the retry so a crash-looping task doesn't spin
             # the dispatch path (reference: task_retry_delay_ms)
             threading.Thread(target=_requeue, daemon=True).start()
+        elif oom_reason is not None:
+            self._seal_error(
+                spec,
+                OutOfMemoryError(
+                    f"task {spec['name']} failed: {oom_reason} "
+                    f"(retries exhausted: {spec['max_retries']})"
+                ),
+            )
         else:
             self._seal_error(
                 spec,
@@ -753,6 +830,7 @@ class Raylet:
                     if spec in self._queued:
                         self._queued.remove(spec)
                     worker.current_task = spec
+                    worker.task_started = time.monotonic()
                     worker.assigned_chips = assignment["chips"]
                 self._push_task(worker, spec, assignment)
                 dispatched = True

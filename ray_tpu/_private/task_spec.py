@@ -44,6 +44,7 @@ def make_task_spec(
     args: tuple = (),
     kwargs: dict | None = None,
     num_returns: int = 1,
+    streaming: bool = False,
     resources: dict[str, float] | None = None,
     actor_id: ActorID | None = None,
     seqno: int = 0,
@@ -85,6 +86,10 @@ def make_task_spec(
         "args_blob": args_blob,
         "arg_deps": arg_deps,
         "num_returns": num_returns,
+        # streaming: yielded values seal at return indices 1..n as produced;
+        # index 0 is the completion marker (count or error) — reference:
+        # streaming generator returns, _raylet.pyx:957-1043
+        "streaming": streaming,
         "resources": resources or {"CPU": 1.0},
         "actor_id": actor_id.binary() if actor_id else None,
         "seqno": seqno,

@@ -208,7 +208,7 @@ class CoreWorker:
             self._owned.add(oid.binary())
         return ObjectRef(oid)
 
-    def put_object(self, oid: ObjectID, value: Any) -> None:
+    def put_object(self, oid: ObjectID, value: Any, pin: bool = True) -> None:
         chunks = ser.serialize(value)
         size = ser.serialized_size(chunks)
         try:
@@ -222,8 +222,10 @@ class CoreWorker:
             ser.write_chunks(chunks, buf)
             # primary copy: pinned atomically at seal so eviction can never
             # lose an object whose owner still holds references; the raylet
-            # unpins it when the owner's refs hit zero (free_object)
-            self.store.seal(oid, pin=True)
+            # unpins it when the owner's refs hit zero (free_object).
+            # pin=False (streamed values): nobody may ever claim the ref, so
+            # they stay LRU-evictable and recover via lineage if consumed.
+            self.store.seal(oid, pin=pin)
         except BaseException:
             self.store.discard_pending(oid)
             raise
@@ -588,9 +590,28 @@ class CoreWorker:
         try:
             fn = self._load_function(spec)
             args, kwargs = self._resolve_args(spec)
+            if spec.get("streaming"):
+                self._execute_streaming(spec, fn, args, kwargs)
+                return
             result = fn(*args, **kwargs)
             self._store_returns(spec, result)
         except Exception as e:  # noqa: BLE001 — user code may raise anything
+            self._store_error(spec, e)
+
+    def _execute_streaming(self, spec: dict, fn, args, kwargs) -> None:
+        """Generator task: seal each yielded value as return index i (the
+        consumer's ObjectRefGenerator streams them), then the completion
+        marker (count) at index 0 — errors seal into index 0 instead."""
+        tid = TaskID(spec["task_id"])
+        try:
+            n = 0
+            for value in fn(*args, **kwargs):
+                n += 1
+                # unpinned: an unclaimed streamed value must not stay pinned
+                # forever — it is LRU-evictable and lineage-recoverable
+                self.put_object(ObjectID.for_task_return(tid, n), value, pin=False)
+            self._store_returns(spec, n)
+        except Exception as e:  # noqa: BLE001
             self._store_error(spec, e)
 
     # actor instance lives on the worker singleton

@@ -58,6 +58,11 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The memory monitor killed the worker to relieve node memory pressure
+    (reference: ray.exceptions.OutOfMemoryError via worker_killing_policy)."""
+
+
 class ObjectStoreFullError(RayTpuError):
     """Object store is out of memory and eviction could not make room."""
 

@@ -68,6 +68,7 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         worker = global_worker()
         placement, scheduling = _strategy_fields(self._scheduling_strategy)
+        streaming = self._num_returns == "streaming"
         spec = ts.make_task_spec(
             task_id=worker.new_task_id(),
             job_id=worker.job_id,
@@ -76,7 +77,8 @@ class RemoteFunction:
             function_blob=self._function_blob,
             args=args,
             kwargs=kwargs,
-            num_returns=self._num_returns,
+            num_returns=1 if streaming else self._num_returns,
+            streaming=streaming,
             resources=self._resources,
             max_retries=self._max_retries,
             placement=placement,
@@ -84,6 +86,10 @@ class RemoteFunction:
             runtime_env=self._runtime_env,
         )
         refs = worker.submit_task(spec)
+        if streaming:
+            from ray_tpu._private.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0], spec)
         return refs[0] if self._num_returns == 1 else refs
 
 

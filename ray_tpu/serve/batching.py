@@ -1,19 +1,17 @@
 """Dynamic batching — marker decorator + shape-bucket padding helpers.
 
 Equivalent of the reference's @serve.batch (reference: python/ray/serve/
-batching.py:337 _BatchQueue coalescing). Architectural deviation, TPU-first:
-our replicas execute one method at a time (ordered actor queue), so batching
-happens in the ROUTER — calls are coalesced client-side and shipped as one
-actor task. This also lets the batcher pad to fixed size buckets so a jitted
-TPU model sees a closed set of batch shapes (no XLA recompiles), which the
-reference's batcher cannot do (SURVEY.md §7 hard parts: shape-aware batching).
+batching.py:337 _BatchQueue coalescing). Coalescing itself happens
+REPLICA-side in replica.py's _ReplicaBatchQueue — all callers of a replica
+(every driver/proxy process) share one queue, as in the reference — on the
+actor's max_ongoing_requests method pool. TPU-first addition kept from the
+earlier router design: batches pad to fixed size BUCKETS so a jitted model
+sees a closed set of batch shapes (no XLA recompiles — SURVEY.md §7 hard
+parts: shape-aware batching).
 """
 from __future__ import annotations
 
-import threading
-import time
-from concurrent.futures import Future
-from typing import Any, Callable
+from typing import Callable
 
 from ray_tpu.serve.config import BatchConfig
 
@@ -58,81 +56,3 @@ def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
         if n <= b:
             return b
     return buckets[-1]
-
-
-class RouterBatcher:
-    """Client-side coalescer for one (deployment, method).
-
-    submit() returns a Future resolved with that call's single result once
-    the flushed actor call completes. Flush happens when max_batch_size
-    accumulate or the oldest call has waited batch_wait_timeout_s.
-    """
-
-    def __init__(self, config: BatchConfig, flush_fn: Callable[[list], list]):
-        self._config = config
-        # a batch may never exceed the largest bucket, or the padded-shape
-        # guarantee breaks (an oversized batch would ship unpadded)
-        self._max_batch = config.max_batch_size
-        if config.size_buckets:
-            self._max_batch = min(self._max_batch, config.size_buckets[-1])
-        self._flush_fn = flush_fn  # list[payload] -> list[result] (blocking)
-        self._lock = threading.Lock()
-        self._pending: list[tuple[Any, Future]] = []
-        self._timer: threading.Timer | None = None
-
-    def submit(self, payload: Any) -> Future:
-        fut: Future = Future()
-        flush_now = None
-        with self._lock:
-            self._pending.append((payload, fut))
-            if len(self._pending) >= self._max_batch:
-                flush_now = self._take_locked()
-            elif self._timer is None:
-                self._timer = threading.Timer(
-                    self._config.batch_wait_timeout_s, self._flush_timeout
-                )
-                self._timer.daemon = True
-                self._timer.start()
-        if flush_now:
-            self._run_flush(flush_now)
-        return fut
-
-    def _take_locked(self) -> list[tuple[Any, Future]]:
-        batch_items, self._pending = self._pending, []
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
-        return batch_items
-
-    def _flush_timeout(self) -> None:
-        with self._lock:
-            items = self._take_locked()
-        if items:
-            self._run_flush(items)
-
-    def _run_flush(self, items: list[tuple[Any, Future]]) -> None:
-        def work():
-            payloads = [p for p, _ in items]
-            n = len(payloads)
-            if self._config.size_buckets:
-                target = pad_to_bucket(n, self._config.size_buckets)
-                payloads = payloads + [None] * (target - n)
-            try:
-                results = self._flush_fn(payloads)
-            except Exception as e:  # noqa: BLE001 — fan the error out
-                for _, f in items:
-                    f.set_exception(e)
-                return
-            for (_, f), r in zip(items, results):
-                f.set_result(r)
-
-        threading.Thread(target=work, daemon=True).start()
-
-    def flush_and_wait(self, deadline: float) -> None:
-        """Test/shutdown helper: force a flush, wait for pending futures."""
-        with self._lock:
-            items = self._take_locked()
-        if items:
-            self._run_flush(items)
-        for _, f in items:
-            f.result(timeout=max(0.0, deadline - time.monotonic()))

@@ -418,6 +418,7 @@ class ServeController:
             spec["init_args"],
             spec["init_kwargs"],
             ds.config.user_config,
+            max_concurrency,
         )
         rs = _ReplicaState(handle)
         with self._lock:

@@ -4,8 +4,10 @@ Equivalent of the reference's handle/router pair
 (reference: python/ray/serve/handle.py:298 DeploymentHandle;
 serve/_private/router.py:922 Router, :308 PowerOfTwoChoicesReplicaScheduler,
 assign_replica :278). The handle tracks its own in-flight counts per replica
-and picks the lower-loaded of two random replicas; batched methods are
-coalesced client-side (see batching.py — shape-aware, TPU-first).
+and picks the lower-loaded of two random replicas. Batched methods ship as
+ordinary single-payload calls: coalescing happens REPLICA-side (replica.py
+_ReplicaBatchQueue, matching the reference's serve/batching.py:337), so
+callers from different processes share one padded batch.
 """
 from __future__ import annotations
 
@@ -17,8 +19,6 @@ from concurrent.futures import Future
 from typing import Any
 
 import ray_tpu
-from ray_tpu.serve.batching import RouterBatcher
-from ray_tpu.serve.config import BatchConfig
 
 _TABLE_REFRESH_S = 0.25
 
@@ -97,7 +97,6 @@ class _Router:
         self._max_ongoing = 8
         self._inflight: dict[bytes, int] = {}  # actor_id -> count
         self._outstanding: dict[bytes, bytes] = {}  # object_id -> actor_id
-        self._batchers: dict[str, RouterBatcher] = {}
         self._last_refresh = 0.0
         self._controller = None
 
@@ -190,17 +189,15 @@ class _Router:
         self._refresh()
         with self._lock:
             bc = self._batch_configs.get(method_name)
-        if bc is not None:
+        if bc is not None and (len(args) != 1 or kwargs):
             # the @serve.batch contract is one positional payload per call
             # (the method receives the list); extra args/kwargs would be
             # silently dropped replica-side, so reject them here
-            if len(args) != 1 or kwargs:
-                raise TypeError(
-                    f"batched method {self.deployment_name}.{method_name} "
-                    f"takes exactly one positional argument per call, got "
-                    f"args={len(args)} kwargs={sorted(kwargs)}"
-                )
-            return self._call_batched(method_name, bc, args, kwargs)
+            raise TypeError(
+                f"batched method {self.deployment_name}.{method_name} "
+                f"takes exactly one positional argument per call, got "
+                f"args={len(args)} kwargs={sorted(kwargs)}"
+            )
         replica = self._pick_replica(time.monotonic() + 30)
         ref = replica.rt_call.remote(method_name, args, kwargs)
         aid = replica._actor_id.binary()
@@ -209,34 +206,6 @@ class _Router:
             self._inflight[aid] = self._inflight.get(aid, 0) + 1
             self._outstanding[oid] = aid
         return DeploymentResponse(ref=ref, on_done=lambda: self._decrement(oid))
-
-    def _call_batched(
-        self, method_name: str, bc: dict, args: tuple, kwargs: dict
-    ) -> DeploymentResponse:
-        with self._lock:
-            batcher = self._batchers.get(method_name)
-            if batcher is None:
-
-                def flush(payloads, _m=method_name):
-                    replica = self._pick_replica(time.monotonic() + 30)
-                    aid = replica._actor_id.binary()
-                    with self._lock:
-                        n_real = sum(1 for p in payloads if p is not None)
-                        self._inflight[aid] = self._inflight.get(aid, 0) + n_real
-                    try:
-                        ref = replica.rt_batched.remote(_m, payloads)
-                        return ray_tpu.get(ref, timeout=120)
-                    finally:
-                        with self._lock:
-                            self._inflight[aid] = max(
-                                0, self._inflight.get(aid, n_real) - n_real
-                            )
-
-                batcher = RouterBatcher(BatchConfig(**bc), flush)
-                self._batchers[method_name] = batcher
-        fut = batcher.submit((args, kwargs))
-        return DeploymentResponse(future=fut)
-
 
 class _HandleMethod:
     def __init__(self, router: _Router, method_name: str):

@@ -9,11 +9,86 @@ and reports lifecycle state.
 from __future__ import annotations
 
 import inspect
+import threading
+from concurrent.futures import Future
 from typing import Any
 
 import ray_tpu
 from ray_tpu._private import task_spec as ts
-from ray_tpu.serve.batching import get_batch_config
+from ray_tpu.serve.batching import get_batch_config, pad_to_bucket
+
+
+class _ReplicaBatchQueue:
+    """REPLICA-side batch coalescing (reference: serve/batching.py:337
+    _BatchQueue — all callers of a replica share ONE queue, so requests from
+    different driver/proxy processes batch together). Shape-aware TPU
+    addition: batches pad to fixed size buckets so a jitted model sees a
+    closed set of shapes. Caller method-threads park in submit() while
+    sibling concurrent calls (max_ongoing_requests method pool) fill the
+    batch; the thread that completes a batch (or the wait timer) flushes."""
+
+    def __init__(self, fn, config):
+        self._fn = fn
+        self._config = config
+        self._max_batch = config.max_batch_size
+        if config.size_buckets:
+            # a batch may never exceed the largest bucket or padding breaks
+            self._max_batch = min(self._max_batch, config.size_buckets[-1])
+        self._lock = threading.Lock()
+        self._pending: list[tuple[Any, Future]] = []
+        self._timer: threading.Timer | None = None
+
+    def submit(self, payload: Any):
+        fut: Future = Future()
+        flush_now = None
+        with self._lock:
+            self._pending.append((payload, fut))
+            if len(self._pending) >= self._max_batch:
+                flush_now = self._take_locked()
+            elif self._timer is None:
+                self._timer = threading.Timer(
+                    self._config.batch_wait_timeout_s, self._flush_timeout
+                )
+                self._timer.daemon = True
+                self._timer.start()
+        if flush_now:
+            self._flush(flush_now)
+        return fut.result()  # parks this method thread until the batch runs
+
+    def _take_locked(self):
+        items, self._pending = self._pending, []
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        return items
+
+    def _flush_timeout(self) -> None:
+        with self._lock:
+            items = self._take_locked()
+        if items:
+            self._flush(items)
+
+    def _flush(self, items) -> None:
+        payloads = [p for p, _ in items]
+        n = len(payloads)
+        if self._config.size_buckets:
+            target = pad_to_bucket(n, self._config.size_buckets)
+            payloads = payloads + [None] * (target - n)
+        try:
+            results = self._fn(payloads)
+            if self._config.size_buckets:
+                results = list(results)[:n]  # strip padding results
+            if len(results) != n:
+                raise ValueError(
+                    f"batched method returned {len(results)} results for "
+                    f"{n} inputs"
+                )
+        except Exception as e:  # noqa: BLE001 — fan the error out per call
+            for _, f in items:
+                f.set_exception(e)
+            return
+        for (_, f), r in zip(items, results):
+            f.set_result(r)
 
 
 class HandleArg:
@@ -40,7 +115,7 @@ def _resolve_handle_args(value):
 
 class ReplicaActor:
     """One serving replica. Created by the controller with the serialized
-    user callable; methods are invoked by routers via rt_call / rt_batched.
+    user callable; methods are invoked by routers via rt_call.
     The replica actor runs up to max_ongoing_requests methods concurrently
     on its worker's method pool (reference: async replicas bounded by
     max_ongoing_requests), so I/O-bound callables overlap; a TPU-bound
@@ -53,8 +128,10 @@ class ReplicaActor:
         init_args: tuple,
         init_kwargs: dict,
         user_config: dict | None = None,
+        max_ongoing_requests: int = 8,
     ):
         self.deployment_name = deployment_name
+        self._max_ongoing = max(1, int(max_ongoing_requests))
         factory = ts.loads_function(callable_blob)
         init_args = _resolve_handle_args(init_args)
         init_kwargs = _resolve_handle_args(init_kwargs)
@@ -66,6 +143,7 @@ class ReplicaActor:
             self._is_function = True
         if user_config is not None:
             self.reconfigure(user_config)
+        self._batch_queues: dict[str, "_ReplicaBatchQueue"] = {}
 
     # -- control surface --
 
@@ -101,22 +179,41 @@ class ReplicaActor:
     # -- data surface --
 
     def rt_call(self, method_name: str, args: tuple, kwargs: dict):
+        queue = self._batch_queue(method_name)
+        if queue is not None:
+            # one positional payload per call (router enforces); this method
+            # thread parks in the queue while sibling concurrent calls fill
+            # the batch — ALL callers of this replica share one queue
+            return queue.submit(args[0])
         return self._method(method_name)(*args, **kwargs)
 
-    def rt_batched(self, method_name: str, payloads: list):
-        """Batched dispatch: payloads is a list of (args, kwargs) —
-        possibly padded with None by the router's shape bucketing. The user
-        method receives the list of first positional args (the reference's
-        @serve.batch contract) and returns a list of results."""
-        real = [p for p in payloads if p is not None]
-        items = [a[0] for a, _k in real]  # router enforces 1 positional arg
-        results = self._method(method_name)(items)
-        if len(results) != len(real):
-            raise ValueError(
-                f"batched method {method_name} returned {len(results)} results "
-                f"for {len(real)} inputs"
+    def _batch_queue(self, method_name: str):
+        q = self._batch_queues.get(method_name)
+        if q is None:
+            cfg = self.batch_configs().get(method_name)
+            if cfg is None:
+                return None
+            from ray_tpu.serve.config import BatchConfig
+
+            bc = BatchConfig(**cfg)
+            if bc.max_batch_size > self._max_ongoing:
+                # callers park in the bounded method pool, so a batch can
+                # never exceed the concurrency — without the clamp every
+                # batch would stall for the full wait timeout (the reference
+                # warns on this misconfiguration too)
+                print(
+                    f"[serve] {self.deployment_name}.{method_name}: "
+                    f"max_batch_size={bc.max_batch_size} exceeds "
+                    f"max_ongoing_requests={self._max_ongoing}; clamping — "
+                    f"raise max_ongoing_requests to batch larger",
+                    flush=True,
+                )
+                bc.max_batch_size = self._max_ongoing
+            q = self._batch_queues.setdefault(
+                method_name,
+                _ReplicaBatchQueue(self._method(method_name), bc),
             )
-        return list(results)
+        return q
 
     def _method(self, name: str):
         if self._is_function:

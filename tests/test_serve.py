@@ -225,3 +225,49 @@ def test_status_and_multi_replica(serve_cluster):
     assert len(pids) >= 2  # power-of-two routing spreads load
     serve.delete("who")
     assert "who" not in serve.status()
+
+
+def test_batching_is_replica_side_cross_caller(serve_cluster):
+    """Requests from DIFFERENT caller processes (driver handle + HTTP proxy
+    actor) coalesce into ONE padded batch — the queue lives in the replica
+    (reference: serve/batching.py:337), not per-handle."""
+    import threading
+    import urllib.request
+
+    ray_tpu, serve = serve_cluster
+
+    @serve.deployment
+    class B:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=3.0,
+                     size_buckets=(4, 8))
+        def __call__(self, items):
+            # padded to a bucket: items includes None fill
+            n_real = sum(1 for i in items if i is not None)
+            return [{"batch": n_real, "padded": len(items)} for i in items]
+
+    handle = serve.run(B.bind(), name="xbatch", route_prefix="/xbatch",
+                       timeout_s=240)
+    out_http = {}
+
+    def via_http():
+        import json
+
+        req = urllib.request.Request(
+            "http://127.0.0.1:18123/xbatch", data=json.dumps(7).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out_http.update(json.load(r)["result"])
+
+    t = threading.Thread(target=via_http)
+    t.start()
+    time.sleep(0.2)  # both requests inside the same generous batch window
+    out_handle = handle.remote(3).result(timeout=120)
+    t.join(timeout=120)
+    # the two callers (proxy actor process + this driver process) shared one
+    # model call, padded to the 4-bucket
+    assert out_handle["batch"] == 2 and out_http["batch"] == 2, (
+        out_handle, out_http,
+    )
+    assert out_handle["padded"] == 4
+    serve.delete("xbatch")
