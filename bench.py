@@ -197,6 +197,12 @@ def main() -> None:
     kwargs = {}
     if len(sys.argv) > 1:
         kwargs["batch_size"] = int(sys.argv[1])
+    # env overrides (rehearsal on small machines / driver experiments)
+    for name, key in (("BENCH_BATCH_SIZE", "batch_size"),
+                      ("BENCH_STEPS", "steps"),
+                      ("BENCH_IMAGE_SIZE", "image_size")):
+        if os.environ.get(name):
+            kwargs[key] = int(os.environ[name])
 
     # Tiny probe first: lands a real measured number within ~a minute so a
     # stall during the full-size run can still report throughput.
@@ -207,6 +213,7 @@ def main() -> None:
     except Exception:
         probe = None
 
+    start = time.monotonic()
     try:
         result = run_bench(**kwargs)
     except Exception as e:
@@ -227,6 +234,27 @@ def main() -> None:
                     "error": f"{type(e).__name__}: {e}; fallback: "
                              f"{type(e2).__name__}: {e2}"[:500],
                 }
+    _publish(result)
+    # Orchestration-overhead parity (the reference's REAL acceptance bar:
+    # <=~2.5% vs native, benchmarks.rst:56): measured in a CPU subprocess so
+    # it cannot disturb the chip result; skipped if the budget is tight.
+    remaining = budget - (time.monotonic() - start) - 30.0
+    if remaining > 60.0:
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            r = subprocess.run(
+                [sys.executable, "-m", "ray_tpu.benchmarks.trainer_overhead"],
+                capture_output=True, text=True, timeout=remaining, env=env,
+            )
+            if r.returncode == 0:
+                overhead = json.loads(r.stdout.strip().splitlines()[-1])
+                result["trainer_overhead_pct"] = overhead["trainer_overhead_pct"]
+                _publish(result)
+        except Exception:
+            pass  # parity measure is auxiliary; never lose the main number
     if _claim_print():
         print(json.dumps(result), flush=True)
     os._exit(0)

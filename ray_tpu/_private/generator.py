@@ -11,7 +11,6 @@ marker.
 """
 from __future__ import annotations
 
-import time
 
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.object_ref import ObjectRef
@@ -39,21 +38,23 @@ class ObjectRefGenerator:
         if self._count is not None and self._i > self._count:
             raise StopIteration
         oid_i = ObjectID.for_task_return(self._task_id, self._i)
+        oid_0 = self._completed_ref.object_id
         while self._count is None:
-            # value already produced? stream it out eagerly
-            st = w.store.status(oid_i)
-            if st == "present":
+            # remote producers: keep pulls triggered for both the value and
+            # the completion marker
+            w._maybe_fetch(oid_i)
+            w._maybe_fetch(oid_0)
+            # block in the daemon until the value (stream it out eagerly)
+            # or the completion marker (count / producer error) seals —
+            # the OP_WAIT cv replaces any status busy-polling
+            present = w.store.wait_objects([oid_i, oid_0], 1, timeout_ms=200)
+            if oid_i.binary() in present:
                 break
-            w._maybe_fetch(oid_i, status=st)
-            # completion marker sealed? (also carries producer errors)
-            st0 = w.store.status(self._completed_ref.object_id)
-            if st0 == "present":
+            if oid_0.binary() in present:
                 self._count = int(w.get(self._completed_ref))  # raises errors
                 if self._i > self._count:
                     raise StopIteration
                 break
-            w._maybe_fetch(self._completed_ref.object_id, status=st0)
-            time.sleep(0.01)
         ref = ObjectRef(oid_i)
         # the consumer now owns this value like any task return: lineage for
         # reconstruction, ownership for zero-ref freeing

@@ -22,7 +22,8 @@ from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError, GetTimeoutError
 
 (OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE, OP_CONTAINS, OP_LIST,
- OP_STATS, OP_SHUTDOWN, OP_SUBSCRIBE, OP_ABORT, OP_PIN, OP_UNPIN) = range(1, 14)
+ OP_STATS, OP_SHUTDOWN, OP_SUBSCRIBE, OP_ABORT, OP_PIN, OP_UNPIN,
+ OP_WAIT) = range(1, 15)
 ST_OK, ST_NOT_FOUND, ST_EXISTS, ST_FULL, ST_TIMEOUT, ST_ERR, ST_EVICTED = range(7)
 EV_SEALED, EV_EVICTED = 1, 2
 
@@ -128,6 +129,7 @@ class ObjectStoreClient:
     MAX_MAPPINGS = 4096
 
     def __init__(self, socket_path: str):
+        self._socket_path = socket_path
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         deadline = time.monotonic() + 10
         while True:
@@ -300,6 +302,31 @@ class ObjectStoreClient:
         same object succeeds cleanly."""
         self.discard_pending(object_id)
         self._request(OP_ABORT, object_id.binary())
+
+    def wait_objects(
+        self, object_ids: list[ObjectID], num_returns: int, timeout_ms: int
+    ) -> set[bytes]:
+        """BLOCK in the daemon until >= num_returns of object_ids are
+        present (or timeout); returns the present subset. Replaces
+        client-side contains() busy-polling — the daemon's seal cv wakes
+        waiters the moment an object lands. Runs on its own ephemeral
+        connection so it never stalls this client's request socket."""
+        ids = [o.binary() for o in object_ids]
+        payload = struct.pack("<QII", timeout_ms, num_returns, len(ids)) + b"".join(ids)
+        msg = struct.pack("<IB", 1 + 28 + len(payload), OP_WAIT) + b"\x00" * 28 + payload
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            sock.connect(self._socket_path)
+            sock.sendall(msg)
+            header = _recv_exact(sock, 4)
+            (length,) = struct.unpack("<I", header)
+            body = _recv_exact(sock, length)
+        finally:
+            sock.close()
+        if body[0] != ST_OK:
+            raise RuntimeError(f"wait failed: status {body[0]}")
+        (m,) = struct.unpack_from("<I", body, 1)
+        return {body[5 + i * 28 : 5 + (i + 1) * 28] for i in range(m)}
 
     def pin(self, object_id: ObjectID) -> bool:
         """Long-lived reference (primary-copy pin): the object may spill

@@ -263,9 +263,9 @@ class Raylet:
         interval = cfg.memory_monitor_refresh_ms / 1000.0
         while not self._stopped.wait(interval):
             try:
-                if not monitor.is_over_threshold():
-                    continue
                 frac = monitor.usage_fraction()
+                if frac is None or frac <= cfg.memory_usage_threshold:
+                    continue
                 victim = self._pick_oom_victim(
                     f"worker killed by the memory monitor: node memory usage "
                     f"{frac:.0%} > threshold {cfg.memory_usage_threshold:.0%}"
@@ -306,6 +306,7 @@ class Raylet:
 
     def _on_store_event(self, ev: int, oid: bytes) -> None:
         """Store seal/evict notification (runs on the subscriber thread)."""
+        resolved = False
         with self._lock:
             self._dir_pending.append(
                 ("s" if ev == osmod.EV_SEALED else "e", oid)
@@ -317,9 +318,24 @@ class Raylet:
                     # primary copies pin themselves atomically at seal
                     # (seal(pin=True)); track so free_object unpins once
                     self._pinned.add(oid)
+                # PUSH-based dependency resolution: a seal is exactly the
+                # event the dep manager waits for (reference: the raylet's
+                # DependencyManager subscribes to object availability) — the
+                # slow _dep_loop poll remains only for remote fetches and
+                # eviction detection
+                for task_id, deps in list(self._missing_deps.items()):
+                    if oid in deps:
+                        deps.discard(oid)
+                        self._dep_fetch_ts.pop(oid, None)
+                        if not deps:
+                            del self._missing_deps[task_id]
+                            resolved = True
             else:
                 self._pinned.discard(oid)
         self._dir_event.set()
+        if resolved:
+            with self._dispatch_cv:
+                self._dispatch_cv.notify_all()
 
     def _republish_store_contents(self) -> None:
         """After a GCS restart the (in-memory) object directory is empty:
@@ -502,12 +518,13 @@ class Raylet:
     # ------------- dependency resolution -------------
 
     def _dep_loop(self) -> None:
-        """Poll the store for missing deps (reference: DependencyManager
-        subscribes to object-location pubsub; the shared-host store makes a
-        contains-poll sufficient)."""
+        """Slow safety-net sweep over missing deps: LOCAL seals resolve
+        instantly via the store event stream (_on_store_event); this loop
+        only triggers remote pulls and detects cluster-wide eviction, so a
+        100ms cadence suffices (was a 5ms contains-poll)."""
         from ray_tpu.exceptions import ObjectLostError
 
-        while not self._stopped.wait(0.005):
+        while not self._stopped.wait(0.1):
             resolved_any = False
             with self._lock:
                 items = [(tid, set(deps)) for tid, deps in self._missing_deps.items()]

@@ -364,30 +364,41 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: list[ObjectRef] = []
-        last_fetch = 0.0
-        while len(ready) < num_returns:
-            now = time.monotonic()
-            # objects produced on other nodes must be pulled into the local
-            # store before they can ever turn up present; reuse the same
-            # status poll for readiness and (throttled) fetch triggering
-            do_fetch = now - last_fetch > 0.2
-            if do_fetch:
-                last_fetch = now
+        # trigger remote pulls BEFORE the first blocking window: a short
+        # (or zero) timeout must still initiate fetches or repeated polls
+        # of a remote object would never make progress
+        for r in pending:
+            self._maybe_fetch(r.object_id)
+        while True:
+            # one BLOCKING store-side wait per window (the daemon's seal cv
+            # wakes us the instant an object lands — no busy-polling); the
+            # window bounds how often we re-trigger fetches of objects that
+            # live on other nodes
+            window_ms = 200
+            if deadline is not None:
+                left_ms = int((deadline - time.monotonic()) * 1000)
+                if left_ms <= 0:
+                    window_ms = 0
+                else:
+                    window_ms = min(window_ms, left_ms)
+            present = self.store.wait_objects(
+                [r.object_id for r in pending],
+                max(1, num_returns - len(ready)),
+                timeout_ms=window_ms,
+            )
             for r in list(pending):
-                st = self.store.status(r.object_id)
-                if st == "present":
+                if r.object_id.binary() in present:
                     ready.append(r)
                     pending.remove(r)
                     # observed completion releases the task's argument refs
                     # (same as get(); fire-and-forget is swept lazily)
                     self._release_task_dep_holds(r.object_id.task_id().binary())
-                elif do_fetch:
-                    self._maybe_fetch(r.object_id, status=st)
             if len(ready) >= num_returns:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(0.002)
+            for r in pending:
+                self._maybe_fetch(r.object_id)
         return ready, pending
 
     def as_future(self, ref: ObjectRef) -> Future:

@@ -1,0 +1,102 @@
+"""Trainer-orchestration overhead: JaxTrainer vs a raw jax loop.
+
+The reference's real acceptance bar is orchestration overhead ≤ ~2.5% vs
+the native distributed backend (reference: doc/source/train/benchmarks.rst:56
+Torch parity tables). Here: the SAME jitted train step for the SAME number
+of steps, (a) as a bare loop in this process, (b) inside a JaxTrainer
+worker with report() plumbing every 10 steps. Both measure the post-warmup
+step loop only (compile excluded on both sides), so the delta is the
+framework's per-step cost. Prints one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+STEPS = 3000
+REPORT_EVERY = 50
+DIM = 256
+
+
+def _build_step():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    jax.config.update("jax_platforms", "cpu")
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (DIM, DIM)) * 0.02
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, DIM))
+    y = jax.random.normal(jax.random.PRNGKey(2), (64, DIM))
+    tx = optax.sgd(1e-3)
+    opt = tx.init(w)
+
+    @jax.jit
+    def step(w, opt):
+        def loss_fn(w):
+            return jnp.mean((jnp.tanh(x @ w) @ w.T - y) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        up, opt = tx.update(g, opt)
+        return optax.apply_updates(w, up), opt, loss
+
+    return step, w, opt
+
+
+def _timed_loop(report=None) -> float:
+    """Run STEPS post-warmup steps; returns the loop wall time."""
+    step, w, opt = _build_step()
+    w, opt, loss = step(w, opt)  # compile
+    float(loss)
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        w, opt, loss = step(w, opt)
+        if report is not None and (i + 1) % REPORT_EVERY == 0:
+            report({"step": i + 1, "loss": float(loss)})
+    float(loss)
+    return time.perf_counter() - t0
+
+
+def run_raw() -> float:
+    return _timed_loop()
+
+
+def run_trainer() -> float:
+    import ray_tpu
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig, report
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+
+    def loop(config):
+        dt = _timed_loop(report=report)
+        report({"loop_s": dt})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="overhead-bench"),
+    ).fit()
+    if result.error:
+        raise RuntimeError(result.error)
+    return float(result.metrics["loop_s"])
+
+
+def main() -> None:
+    raw_s = run_raw()
+    trainer_s = run_trainer()
+    overhead = (trainer_s - raw_s) / raw_s * 100.0
+    print(
+        json.dumps(
+            {
+                "steps": STEPS,
+                "raw_s": round(raw_s, 3),
+                "trainer_s": round(trainer_s, 3),
+                "trainer_overhead_pct": round(overhead, 2),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

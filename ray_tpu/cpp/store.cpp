@@ -25,7 +25,10 @@
 //      the plasma→raylet notification socket analog, feeding the object
 //      directory); 11=ABORT (drop an unsealed create, e.g. failed pull);
 //      12=PIN / 13=UNPIN (long-lived reference by the raylet for primary
-//      copies — pinned objects are never LRU-evicted, only spilled).
+//      copies — pinned objects are never LRU-evicted, only spilled);
+//      14=WAIT (payload: u64 timeout_ms, u32 k, u32 n, n*28B ids → reply
+//      u32 m + m*28B ids that are present, blocking until >=k or timeout —
+//      the native replacement for client-side contains() busy-polling).
 // status: 0=OK 1=NOT_FOUND 2=EXISTS 3=FULL 4=TIMEOUT 5=ERR 6=EVICTED
 //
 // Spilling (reference: raylet/local_object_manager.cc spill/restore +
@@ -66,7 +69,7 @@ namespace {
 constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
                   OP_DELETE = 5, OP_CONTAINS = 6, OP_LIST = 7, OP_STATS = 8,
                   OP_SHUTDOWN = 9, OP_SUBSCRIBE = 10, OP_ABORT = 11,
-                  OP_PIN = 12, OP_UNPIN = 13;
+                  OP_PIN = 12, OP_UNPIN = 13, OP_WAIT = 14;
 constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_FULL = 3,
                   ST_TIMEOUT = 4, ST_ERR = 5, ST_EVICTED = 6;
 constexpr uint8_t EV_SEALED = 1, EV_EVICTED = 2;
@@ -186,6 +189,28 @@ class Store {
   }
 
   uint8_t Unpin(const std::string &id) { return Release(id); }
+
+  // Block until >= k of `ids` are present (sealed, in memory or spilled)
+  // or the deadline passes; returns the present subset. The seal cv wakes
+  // every waiter, so one daemon serves many concurrent wait() calls
+  // without any client-side polling.
+  std::vector<std::string> WaitAny(const std::vector<std::string> &ids,
+                                   size_t k, uint64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    bool timed_out = false;
+    for (;;) {
+      std::vector<std::string> present;
+      for (const auto &id : ids) {
+        auto it = objects_.find(id);
+        if (it != objects_.end() && it->second.sealed) present.push_back(id);
+      }
+      if (present.size() >= k || timeout_ms == 0 || timed_out) return present;
+      timed_out =
+          sealed_cv_.wait_until(lk, deadline) == std::cv_status::timeout;
+    }
+  }
 
   uint8_t Delete(const std::string &id) {
     std::unique_lock<std::mutex> lk(mu_);
@@ -580,6 +605,32 @@ void ServeClient(Store *store, int fd) {
         unsealed.erase(id);
         SendResp(fd, ST_OK);
         break;
+      case OP_WAIT: {
+        if (payload_len < 16) {
+          SendResp(fd, ST_ERR);
+          break;
+        }
+        uint64_t timeout_ms;
+        uint32_t k, n;
+        memcpy(&timeout_ms, payload, 8);
+        memcpy(&k, payload + 8, 4);
+        memcpy(&n, payload + 12, 4);
+        if (payload_len < 16 + (size_t)n * ID_SIZE) {
+          SendResp(fd, ST_ERR);
+          break;
+        }
+        std::vector<std::string> ids;
+        ids.reserve(n);
+        for (uint32_t i = 0; i < n; i++)
+          ids.emplace_back(payload + 16 + i * ID_SIZE, ID_SIZE);
+        auto present = store->WaitAny(ids, k, timeout_ms);
+        std::string out;
+        uint32_t m = (uint32_t)present.size();
+        out.append((char *)&m, 4);
+        for (auto &s : present) out += s;
+        SendResp(fd, ST_OK, out);
+        break;
+      }
       case OP_PIN:
         SendResp(fd, store->Pin(id));
         break;
@@ -673,14 +724,13 @@ int main(int argc, char **argv) {
   printf("READY\n");
   fflush(stdout);
 
-  std::vector<std::thread> threads;
   while (!g_shutdown) {
     int fd = accept(srv, nullptr, nullptr);
     if (fd < 0) break;
-    threads.emplace_back(ServeClient, &store, fd);
+    // detach immediately: connections may be ephemeral (one per wait()
+    // window) — an unbounded join-list would leak a handle per connection
+    std::thread(ServeClient, &store, fd).detach();
   }
-  for (auto &t : threads)
-    if (t.joinable()) t.detach();
   store.StopNotifier();
   store.UnlinkAll();
   unlink(sock_path);
