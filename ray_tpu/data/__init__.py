@@ -6,6 +6,7 @@ streaming_executor.py:49). All block transforms run as ray_tpu tasks over
 object-store blocks; ingestion ends in `iter_jax_batches` device feeding.
 """
 from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.compute import ActorPoolStrategy, TaskPoolStrategy
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.iterator import DataIterator
@@ -25,6 +26,8 @@ from ray_tpu.data.datasource import (
 )
 
 __all__ = [
+    "ActorPoolStrategy",
+    "TaskPoolStrategy",
     "BlockAccessor",
     "DataContext",
     "DataIterator",
@@ -43,3 +46,9 @@ __all__ = [
     "read_parquet",
     "read_text",
 ]
+
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("data")
+del _rlu

@@ -25,6 +25,7 @@ from ray_tpu.data.block import (
     batch_to_table,
     format_batch,
 )
+from ray_tpu.data.compute import ActorPoolStrategy, TaskPoolStrategy
 from ray_tpu.data.context import DataContext
 
 # ---------------------------------------------------------------------------
@@ -49,11 +50,15 @@ class _FromBundles(_Op):
 
 class _MapBlock(_Op):
     """Any one-to-one block transform (map/filter/flat_map/map_batches/
-    project); fusable."""
+    project); fusable. With `compute` set (an ActorPoolStrategy), `fn` is a
+    FACTORY returning the block transform — instantiated once per pool actor
+    — and the op forms its own (non-fused) stage."""
 
-    def __init__(self, fn: Callable[[pa.Table], pa.Table], name: str):
+    def __init__(self, fn: Callable[[pa.Table], pa.Table], name: str,
+                 compute=None):
         self.fn = fn
         self.name = name
+        self.compute = compute
 
 
 class _Limit(_Op):
@@ -154,15 +159,43 @@ class Dataset:
         batch_size: Optional[int] = None,
         batch_format: Optional[str] = None,
         fn_kwargs: Optional[dict] = None,
+        fn_constructor_args: Optional[tuple] = None,
+        fn_constructor_kwargs: Optional[dict] = None,
+        compute=None,
+        concurrency=None,
         **_ignored,
     ) -> "Dataset":
         """Apply fn to batches (reference: dataset.py:397). fn receives the
         batch in `batch_format` (numpy dict default / pandas / pyarrow) and
-        returns same-ish; batch_size splits within a block."""
+        returns same-ish; batch_size splits within a block.
+
+        A CLASS `fn` is stateful: it runs on an autoscaling actor pool
+        (default ActorPoolStrategy(1, 1); pass `compute=` or `concurrency=`
+        to size it), constructed once per actor with fn_constructor_args —
+        the reference's ActorPoolMapOperator path (compute.py:71)."""
         fmt = batch_format or self._ctx.default_batch_format
         kwargs = fn_kwargs or {}
+        is_class = isinstance(fn, type)
 
-        def do(table: pa.Table) -> pa.Table:
+        if concurrency is not None and compute is None:
+            if isinstance(concurrency, (tuple, list)):
+                compute = ActorPoolStrategy(int(concurrency[0]),
+                                            int(concurrency[1]))
+            else:
+                compute = ActorPoolStrategy(int(concurrency), int(concurrency))
+        if is_class and compute is None:
+            compute = ActorPoolStrategy()
+        if compute is not None and not isinstance(compute, ActorPoolStrategy):
+            if isinstance(compute, TaskPoolStrategy):
+                compute = None
+            else:
+                raise TypeError(f"unsupported compute strategy: {compute!r}")
+        if is_class and compute is None:
+            raise ValueError("a callable class requires an ActorPoolStrategy")
+        if compute is not None and not is_class and fn_constructor_args:
+            raise ValueError("fn_constructor_args requires a class fn")
+
+        def apply_batches(callable_fn, table: pa.Table) -> pa.Table:
             n = table.num_rows
             if n == 0:
                 return table
@@ -170,11 +203,21 @@ class Dataset:
             outs = []
             for start in range(0, n, size):
                 piece = table.slice(start, min(size, n - start))
-                out = fn(format_batch(piece, fmt), **kwargs)
+                out = callable_fn(format_batch(piece, fmt), **kwargs)
                 outs.append(batch_to_table(out))
             return BlockAccessor.concat(outs)
 
-        return self._map_op(do, "map_batches")
+        if compute is None:
+            return self._map_op(lambda t: apply_batches(fn, t), "map_batches")
+
+        ctor_args = fn_constructor_args or ()
+        ctor_kwargs = fn_constructor_kwargs or {}
+
+        def make_fn():
+            inst = fn(*ctor_args, **ctor_kwargs) if is_class else fn
+            return lambda t: apply_batches(inst, t)
+
+        return self._with(_MapBlock(make_fn, "map_batches", compute=compute))
 
     def add_column(self, name: str, fn: Callable) -> "Dataset":
         def do(table: pa.Table) -> pa.Table:
@@ -362,6 +405,17 @@ class Dataset:
             elif isinstance(op, _FromBundles):
                 stream = iter(op.bundles)
             elif isinstance(op, _MapBlock):
+                if op.compute is not None:
+                    # actor stage: own (non-fused) stage over an actor pool
+                    upstream = flush()
+
+                    def srcs(u=upstream):
+                        for ref, _m in u:
+                            yield ref
+
+                    stream = ex.run_actor_stage(
+                        srcs(), ts.dumps_function(op.fn), op.compute, ctx)
+                    continue
                 if limit is not None:
                     # a map after a limit must see only the limited rows —
                     # flush so the truncation happens before this fn

@@ -169,6 +169,114 @@ def run_oneone_stage(
             yield block_ref, meta
 
 
+class _PoolWorker:
+    """Stateful block-transform actor: the factory blob is deserialized and
+    CALLED once at construction (instantiating the user's callable class
+    there), then every block reuses the instance (reference:
+    actor_pool_map_operator.py — _MapWorker)."""
+
+    def __init__(self, factory_blob: bytes):
+        self._fn = ts.loads_function(factory_blob)()
+
+    def run(self, source: Any):
+        table = self._fn(source)
+        # put the block from the actor so only (ref, meta) crosses back to
+        # the driver — the block itself stays in the object store
+        return ray_tpu.put(table), _meta_of(table)
+
+    def ping(self):
+        return True
+
+
+def run_actor_stage(
+    sources: Iterator[Any],
+    factory_blob: bytes,
+    strategy,
+    ctx: DataContext,
+    limit_rows: Optional[int] = None,
+) -> Iterator[RefBundle]:
+    """Stream blocks through an autoscaling pool of `_PoolWorker` actors.
+
+    Scale-up rule: if every live actor is saturated (max_tasks_in_flight
+    queued) and input remains, add an actor, up to strategy.max_size.
+    Output preserves submission order, same as run_oneone_stage.
+    """
+    opts = dict(num_cpus=strategy.num_cpus)
+    if strategy.resources:
+        opts["resources"] = strategy.resources
+    Worker = ray_tpu.remote(**opts)(_PoolWorker)
+
+    pool = [Worker.remote(factory_blob) for _ in range(strategy.min_size)]
+    load = {id(a): 0 for a in pool}  # actor -> queued block count
+    by_id = {id(a): a for a in pool}
+    inflight: dict = {}  # result_ref -> (seq, actor_id)
+    done: dict = {}  # seq -> RefBundle
+    sources = iter(sources)
+    exhausted = False
+    submitted = 0
+    next_seq = 0
+    yielded_rows = 0
+    cap = strategy.max_tasks_in_flight_per_actor
+
+    def pick_actor():
+        aid = min(load, key=lambda k: load[k])
+        if load[aid] >= cap:
+            if len(pool) < strategy.max_size:
+                a = Worker.remote(factory_blob)
+                pool.append(a)
+                load[id(a)] = 0
+                by_id[id(a)] = a
+                return id(a)
+            return None
+        return aid
+
+    def submit_one() -> bool:
+        nonlocal exhausted, submitted
+        aid = pick_actor()
+        if aid is None:
+            return False
+        try:
+            src = next(sources)
+        except StopIteration:
+            exhausted = True
+            return False
+        ref = by_id[aid].run.remote(src)
+        inflight[ref] = (submitted, aid)
+        load[aid] += 1
+        submitted += 1
+        return True
+
+    try:
+        while True:
+            while (not exhausted
+                   and (limit_rows is None or yielded_rows < limit_rows)):
+                if not submit_one():
+                    break
+            if not inflight and not done:
+                return
+            if inflight:
+                ready, _ = ray_tpu.wait(list(inflight.keys()), num_returns=1,
+                                        timeout=600)
+                for ref in ready:
+                    seq, aid = inflight.pop(ref)
+                    load[aid] -= 1
+                    block_ref, meta = ray_tpu.get(ref, timeout=600)
+                    done[seq] = (block_ref, meta)
+            while next_seq in done:
+                block_ref, meta = done.pop(next_seq)
+                next_seq += 1
+                if meta.num_rows == 0:
+                    continue
+                yielded_rows += meta.num_rows
+                yield block_ref, meta
+    finally:
+        for a in pool:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
 def run_all_to_all(
     bundles: List[RefBundle],
     map_blob: bytes,

@@ -8,12 +8,29 @@ iteration.
 """
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms import (
+    APPO,
+    APPOConfig,
     DQN,
     DQNConfig,
     IMPALA,
     ImpalaConfig,
     PPO,
     PPOConfig,
+)
+from ray_tpu.rllib.connectors import (
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    FrameStack,
+    NormalizeObs,
+)
+from ray_tpu.rllib.multi_agent import (
+    IndependentMultiEnv,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
 )
 from ray_tpu.rllib.env import (
     CartPole,
@@ -30,10 +47,23 @@ from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rl_module import ActorCriticModule, QModule
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
     "ActorCriticModule",
     "Algorithm",
     "AlgorithmConfig",
     "CartPole",
+    "ClipObs",
+    "Connector",
+    "ConnectorPipeline",
+    "FlattenObs",
+    "FrameStack",
+    "IndependentMultiEnv",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "NormalizeObs",
     "Corridor",
     "DQN",
     "DQNConfig",
@@ -51,3 +81,9 @@ __all__ = [
     "make_env",
     "register_env",
 ]
+
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("rllib")
+del _rlu

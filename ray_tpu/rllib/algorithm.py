@@ -24,6 +24,7 @@ class AlgorithmConfig:
         self.num_env_runners = 0  # 0 = sample in the driver process
         self.num_envs_per_runner = 4
         self.rollout_length = 64
+        self.connectors = None  # list of Connector instances (or None)
         self.lr = 3e-4
         self.gamma = 0.99
         self.train_batch_size = 256
@@ -33,6 +34,7 @@ class AlgorithmConfig:
         self.max_grad_norm = 0.5
         self.seed = 0
         self.mesh = None  # optional jax Mesh with a 'data' axis for the learner
+        self.output = None  # JSONL experience-output path (offline_data)
         self.extra: dict = {}
 
     # -- builder surface (mirrors the reference's groups) --
@@ -46,6 +48,7 @@ class AlgorithmConfig:
         num_env_runners: int | None = None,
         num_envs_per_runner: int | None = None,
         rollout_length: int | None = None,
+        connectors: list | None = None,
     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -53,6 +56,8 @@ class AlgorithmConfig:
             self.num_envs_per_runner = num_envs_per_runner
         if rollout_length is not None:
             self.rollout_length = rollout_length
+        if connectors is not None:
+            self.connectors = connectors
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -65,6 +70,13 @@ class AlgorithmConfig:
 
     def learners(self, mesh=None) -> "AlgorithmConfig":
         self.mesh = mesh
+        return self
+
+    def offline_data(self, output: str | None = None) -> "AlgorithmConfig":
+        """Log every sampled rollout batch to a JSONL experience file
+        (reference: config.offline_data(output=...) → JsonWriter)."""
+        if output is not None:
+            self.output = output
         return self
 
     def debugging(self, seed: int | None = None) -> "AlgorithmConfig":
@@ -96,6 +108,7 @@ class Algorithm:
         self._local_runner = None
         self._recent_returns: list[float] = []
         self._total_env_steps = 0
+        self._output_writer = None
         self._setup()
 
     # -- setup --
@@ -116,6 +129,7 @@ class Algorithm:
                     rollout_length=cfg.rollout_length,
                     seed=cfg.seed + 1 + i,
                     mode=self.runner_mode,
+                    connectors=cfg.connectors,
                 )
                 for i in range(cfg.num_env_runners)
             ]
@@ -132,6 +146,7 @@ class Algorithm:
                 rollout_length=cfg.rollout_length,
                 seed=cfg.seed,
                 mode=self.runner_mode,
+                connectors=cfg.connectors,
             )
             info = self._local_runner.env_info()
         self.obs_dim = info["observation_dim"]
@@ -167,6 +182,12 @@ class Algorithm:
         self._recent_returns.extend(b["episode_returns"].tolist())
         self._recent_returns = self._recent_returns[-100:]
         self._total_env_steps += b["rewards"].size
+        if self.config.output is not None:
+            if self._output_writer is None:
+                from ray_tpu.rllib.offline import JsonWriter
+
+                self._output_writer = JsonWriter(self.config.output)
+            self._output_writer.write_batch(b)
 
     def _sample_all(self) -> list[dict]:
         """synchronous_parallel_sample (reference: rollout_ops.py:21)."""

@@ -10,3 +10,7 @@ __all__ += ["A2C", "A2CConfig", "SAC", "SACConfig"]
 from ray_tpu.rllib.algorithms.impala import IMPALA, ImpalaConfig
 
 __all__ += ["IMPALA", "ImpalaConfig"]
+
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+
+__all__ += ["APPO", "APPOConfig"]

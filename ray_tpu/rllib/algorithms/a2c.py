@@ -1,25 +1,92 @@
 """A2C — synchronous advantage actor-critic.
 
 Equivalent of the reference's A2C (reference: rllib/algorithms/a2c/a2c.py —
-one synchronous gradient step per rollout batch; deprecated upstream in
-favor of PPO but part of the algorithm surface). Implemented as PPO with a
-single whole-batch update: on the first (only) pass the importance ratio is
-exactly 1, so the clipped surrogate reduces to the vanilla policy gradient
--logp * advantage.
+one synchronous gradient step per rollout batch over the vanilla
+policy-gradient loss; deprecated upstream in favor of PPO but part of the
+algorithm surface). Unlike PPO there is no surrogate ratio and no minibatch
+epochs: advantages are GAE, the update is a single whole-batch step of
+-logp * A, jitted in the Learner.
 """
 from __future__ import annotations
 
-from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import compute_gae
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.rl_module import ActorCriticModule
 
 
-class A2CConfig(PPOConfig):
+def a2c_loss(module, params, batch, config):
+    """Vanilla policy gradient + value loss + entropy bonus (pure jax)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits, values = module.forward(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+    policy_loss = -jnp.mean(logp * batch["advantages"])
+    value_loss = jnp.mean(jnp.square(values - batch["value_targets"]))
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = (
+        policy_loss
+        + config["vf_loss_coeff"] * value_loss
+        - config["entropy_coeff"] * entropy
+    )
+    return total, {
+        "policy_loss": policy_loss,
+        "vf_loss": value_loss,
+        "entropy": entropy,
+    }
+
+
+class A2CConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()
-        self.num_epochs = 1
-        self.minibatch_size = 1 << 30  # whole batch, clamped per rollout
-        self.clip_param = 1e9  # never clips at ratio == 1
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.gae_lambda = 1.0  # classic A2C: plain n-step returns
         self.algo_class = A2C
 
 
-class A2C(PPO):
-    pass
+class A2C(Algorithm):
+    runner_mode = "actor_critic"
+
+    def _runner_factory(self):
+        hidden = tuple(self.config.hidden)
+        return lambda obs_dim, n_act: ActorCriticModule(obs_dim, n_act, hidden)
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        module = ActorCriticModule(self.obs_dim, self.num_actions, cfg.hidden)
+        self.learner = Learner(
+            module,
+            a2c_loss,
+            config={
+                "vf_loss_coeff": cfg.vf_loss_coeff,
+                "entropy_coeff": cfg.entropy_coeff,
+            },
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self._broadcast_weights(self.learner.get_weights_np())
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        batches = self._sample_all()
+        flat = {"obs": [], "actions": [], "advantages": [], "value_targets": []}
+        for b in batches:
+            adv, ret = compute_gae(b, cfg.gamma, cfg.gae_lambda)
+            T, E = b["rewards"].shape
+            flat["obs"].append(b["obs"].reshape(T * E, -1))
+            flat["actions"].append(b["actions"].reshape(-1).astype(np.int32))
+            flat["advantages"].append(adv.reshape(-1))
+            flat["value_targets"].append(ret.reshape(-1))
+        train = {k: np.concatenate(v) for k, v in flat.items()}
+        adv = train["advantages"]
+        train["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        metrics = self.learner.update(train)  # ONE whole-batch step
+        self._broadcast_weights(self.learner.get_weights_np())
+        return metrics

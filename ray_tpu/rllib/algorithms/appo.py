@@ -1,0 +1,96 @@
+"""APPO — asynchronous PPO: IMPALA's architecture, PPO's surrogate.
+
+Equivalent of the reference's APPO (reference: rllib/algorithms/appo/appo.py
+— IMPALA-style continuous async sampling, with the policy update swapped for
+the PPO clipped surrogate over V-trace-corrected advantages, plus a slowly
+refreshed target policy the surrogate is anchored to). TPU mapping is
+IMPALA's: the V-trace recursion runs in-graph as a reverse lax.scan inside
+the jitted learner step; runners are never blocked on the learner.
+"""
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithms.impala import (
+    IMPALA,
+    ImpalaConfig,
+    vtrace_ingraph,
+)
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.rl_module import ActorCriticModule
+
+
+def appo_loss(module, params, batch, config):
+    """Clipped surrogate on V-trace advantages (pure jax).
+
+    The ratio is target-policy/behavior-policy — the behavior logp recorded
+    by the (stale-weighted) sampler stands in for PPO's logp_old, which is
+    exactly the reference APPO formulation: off-policyness is both clipped
+    (surrogate) and corrected (V-trace targets).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    T, E = batch["rewards"].shape
+    obs = batch["obs"].reshape(T * E, -1)
+    logits, values = module.forward(params, obs)
+    logits = logits.reshape(T, E, -1)
+    values = values.reshape(T, E)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+
+    vs, pg_adv, rhos_raw = vtrace_ingraph(logp, values, batch, config)
+    adv = (pg_adv - jnp.mean(pg_adv)) / (jnp.std(pg_adv) + 1e-8)
+
+    ratio = jnp.exp(logp - batch["behavior_logp"])
+    clip = config["clip_param"]
+    surrogate = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv
+    )
+    policy_loss = -jnp.mean(surrogate)
+    value_loss = jnp.mean(jnp.square(values - vs))
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = (
+        policy_loss
+        + config["vf_loss_coeff"] * value_loss
+        - config["entropy_coeff"] * entropy
+    )
+    metrics = {
+        "policy_loss": policy_loss,
+        "vf_loss": value_loss,
+        "entropy": entropy,
+        "mean_rho": jnp.mean(rhos_raw),
+    }
+    return total, metrics
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.num_epochs = 2  # small reuse of each async batch
+        self.algo_class = APPO
+
+
+class APPO(IMPALA):
+    def _build_learner(self) -> None:
+        cfg = self.config
+        module = ActorCriticModule(self.obs_dim, self.num_actions, cfg.hidden)
+        self.learner = Learner(
+            module,
+            appo_loss,
+            config={
+                "gamma": cfg.gamma,
+                "rho_max": cfg.vtrace_rho_clip,
+                "c_max": cfg.vtrace_c_clip,
+                "clip_param": cfg.clip_param,
+                "vf_loss_coeff": cfg.vf_loss_coeff,
+                "entropy_coeff": cfg.entropy_coeff,
+            },
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self._inflight = {}
+        self._broadcast_weights(self.learner.get_weights_np())
+    # training_step is inherited from IMPALA: same async collection and
+    # broadcast; num_epochs=2 reuses each batch through the clipped loss

@@ -50,19 +50,14 @@ def vtrace_reference_np(
     return vs, pg_adv
 
 
-def impala_loss(module, params, batch, config):
-    """V-trace actor-critic loss, fully in-graph (reverse lax.scan)."""
+def vtrace_ingraph(logp, values, batch, config):
+    """In-graph V-trace (reverse lax.scan over T): returns (vs targets,
+    pg advantages, raw importance ratios). Shared by the IMPALA and APPO
+    losses — both correct off-policyness the same way."""
     import jax
     import jax.numpy as jnp
 
-    T, E = batch["rewards"].shape
-    obs = batch["obs"].reshape(T * E, -1)
-    logits, values = module.forward(params, obs)
-    logits = logits.reshape(T, E, -1)
-    values = values.reshape(T, E)
-    logp_all = jax.nn.log_softmax(logits)
-    logp = jnp.take_along_axis(logp_all, batch["actions"][..., None], axis=-1)[..., 0]
-
+    _T, E = batch["rewards"].shape
     gamma = config["gamma"]
     rhos_raw = jnp.exp(jax.lax.stop_gradient(logp) - batch["behavior_logp"])
     rhos = jnp.minimum(rhos_raw, config["rho_max"])
@@ -89,6 +84,23 @@ def impala_loss(module, params, batch, config):
     vs_next = jnp.concatenate([vs[1:], batch["last_values"][None]], axis=0)
     vs_next = jnp.where(batch["dones"], batch["bootstrap_values"], vs_next)
     pg_adv = rhos * (batch["rewards"] + gamma * not_term * vs_next - values_sg)
+    return vs, pg_adv, rhos_raw
+
+
+def impala_loss(module, params, batch, config):
+    """V-trace actor-critic loss, fully in-graph (reverse lax.scan)."""
+    import jax
+    import jax.numpy as jnp
+
+    T, E = batch["rewards"].shape
+    obs = batch["obs"].reshape(T * E, -1)
+    logits, values = module.forward(params, obs)
+    logits = logits.reshape(T, E, -1)
+    values = values.reshape(T, E)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+
+    vs, pg_adv, rhos_raw = vtrace_ingraph(logp, values, batch, config)
 
     policy_loss = -jnp.mean(logp * pg_adv)
     value_loss = jnp.mean(jnp.square(values - vs))
@@ -115,6 +127,7 @@ class ImpalaConfig(AlgorithmConfig):
         self.vf_loss_coeff = 0.5
         self.entropy_coeff = 0.01
         self.max_sample_staleness_s = 300.0
+        self.num_epochs = 1  # IMPALA consumes each async batch once
         self.algo_class = IMPALA
 
 
@@ -190,9 +203,12 @@ class IMPALA(Algorithm):
                 "bootstrap_values": b["bootstrap_values"],
                 "last_values": b["last_values"],
             }
-            m = self.learner.update(train)
-            for k, v in m.items():
-                metrics_acc.setdefault(k, []).append(v)
+            # num_epochs=1 for IMPALA; APPO reuses each batch a few times
+            # (its clipped surrogate tolerates the extra off-policyness)
+            for _ in range(self.config.num_epochs):
+                m = self.learner.update(train)
+                for k, v in m.items():
+                    metrics_acc.setdefault(k, []).append(v)
         # fire-and-forget broadcast: samplers pick the fresh weights up
         # between rollouts; staleness is corrected by V-trace
         w = self.learner.get_weights_np()

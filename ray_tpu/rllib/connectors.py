@@ -1,0 +1,174 @@
+"""Connectors — composable observation/action transform pipelines.
+
+Equivalent of the reference's connector framework (reference:
+rllib/connectors/connector.py — env-to-module pipelines transforming
+observations before action computation, with per-worker state carried in
+checkpoints). Connectors run INSIDE EnvRunner actors on the numpy path: the
+batch the learner sees already holds processed observations, so the jitted
+loss never re-does preprocessing (keeps the device graph pure compute).
+
+Stateful connectors (NormalizeObs running stats, FrameStack buffers) are
+per-runner, like the reference's per-worker connector state; their state
+rides EnvRunner.get_state() for checkpoint/restore.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Connector:
+    """One observation transform step: [E, D_in] -> [E, D_out]."""
+
+    def output_dim(self, in_dim: int) -> int:
+        return in_dim
+
+    def setup(self, num_envs: int, in_dim: int) -> None:
+        pass
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def peek(self, obs: np.ndarray) -> np.ndarray:
+        """Transform WITHOUT advancing internal state (used for bootstrap
+        values on true-final observations)."""
+        return self(obs)
+
+    def on_dones(self, dones: np.ndarray) -> None:
+        """Episode boundaries: reset per-env state where dones[i]."""
+
+    def state(self) -> dict:
+        return {}
+
+    def load_state(self, state: dict) -> None:
+        pass
+
+
+class FlattenObs(Connector):
+    """Flatten trailing observation dims (already-flat obs pass through)."""
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        return obs.reshape(obs.shape[0], -1)
+
+
+class ClipObs(Connector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = float(low), float(high)
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        return np.clip(obs, self.low, self.high)
+
+
+class NormalizeObs(Connector):
+    """Running mean/std normalization (Welford; the reference's
+    MeanStdFilter connector)."""
+
+    def __init__(self, eps: float = 1e-8, clip: float = 10.0):
+        self.eps = eps
+        self.clip = clip
+        self._count = 0.0
+        self._mean: np.ndarray | None = None
+        self._m2: np.ndarray | None = None
+
+    def setup(self, num_envs: int, in_dim: int) -> None:
+        if self._mean is None:
+            self._mean = np.zeros(in_dim, np.float64)
+            self._m2 = np.zeros(in_dim, np.float64)
+
+    def _update(self, obs: np.ndarray) -> None:
+        for row in obs:
+            self._count += 1.0
+            delta = row - self._mean
+            self._mean += delta / self._count
+            self._m2 += delta * (row - self._mean)
+
+    def _apply(self, obs: np.ndarray) -> np.ndarray:
+        if self._count < 2:
+            return obs.astype(np.float32)
+        var = self._m2 / (self._count - 1)
+        out = (obs - self._mean) / np.sqrt(var + self.eps)
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        self._update(obs)
+        return self._apply(obs)
+
+    def peek(self, obs: np.ndarray) -> np.ndarray:
+        return self._apply(obs)
+
+    def state(self) -> dict:
+        return {"count": self._count, "mean": self._mean, "m2": self._m2}
+
+    def load_state(self, state: dict) -> None:
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+class FrameStack(Connector):
+    """Stack the last k observations per env (zero-padded at episode start;
+    buffers cleared at episode boundaries)."""
+
+    def __init__(self, k: int = 4):
+        assert k >= 1
+        self.k = k
+        self._buf: np.ndarray | None = None  # [E, k, D]
+
+    def output_dim(self, in_dim: int) -> int:
+        return in_dim * self.k
+
+    def setup(self, num_envs: int, in_dim: int) -> None:
+        self._buf = np.zeros((num_envs, self.k, in_dim), np.float32)
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        self._buf = np.roll(self._buf, -1, axis=1)
+        self._buf[:, -1] = obs
+        return self._buf.reshape(obs.shape[0], -1)
+
+    def peek(self, obs: np.ndarray) -> np.ndarray:
+        buf = np.roll(self._buf, -1, axis=1)
+        buf[:, -1] = obs
+        return buf.reshape(obs.shape[0], -1)
+
+    def on_dones(self, dones: np.ndarray) -> None:
+        self._buf[dones] = 0.0
+
+    def state(self) -> dict:
+        return {"buf": self._buf}
+
+    def load_state(self, state: dict) -> None:
+        self._buf = state["buf"]
+
+
+class ConnectorPipeline:
+    """Ordered connector chain; the EnvRunner owns one."""
+
+    def __init__(self, connectors: list[Connector] | None = None):
+        self.connectors = list(connectors or [])
+
+    def setup(self, num_envs: int, in_dim: int) -> int:
+        dim = in_dim
+        for c in self.connectors:
+            c.setup(num_envs, dim)
+            dim = c.output_dim(dim)
+        return dim
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        for c in self.connectors:
+            obs = c(obs)
+        return obs
+
+    def peek(self, obs: np.ndarray) -> np.ndarray:
+        for c in self.connectors:
+            obs = c.peek(obs)
+        return obs
+
+    def on_dones(self, dones: np.ndarray) -> None:
+        for c in self.connectors:
+            c.on_dones(dones)
+
+    def state(self) -> list:
+        return [c.state() for c in self.connectors]
+
+    def load_state(self, state: list) -> None:
+        for c, s in zip(self.connectors, state):
+            c.load_state(s)

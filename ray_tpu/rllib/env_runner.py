@@ -27,11 +27,17 @@ class EnvRunner:
         # epsilon_greedy: argmax Q with annealed exploration (DQN family)
         # softmax: sample the module's stochastic policy (SAC family)
         mode: str = "actor_critic",
+        connectors: list | None = None,
     ):
+        from ray_tpu.rllib.connectors import ConnectorPipeline
         from ray_tpu.rllib.env import VectorEnv
 
         self.vec = VectorEnv(env_spec, num_envs, base_seed=seed)
-        self.module = module_factory(self.vec.observation_dim, self.vec.num_actions)
+        self.pipeline = ConnectorPipeline(connectors)
+        # the module (and hence the learner) sees the CONNECTOR-PROCESSED
+        # observation space — e.g. FrameStack(k) multiplies the dim by k
+        self.obs_dim = self.pipeline.setup(num_envs, self.vec.observation_dim)
+        self.module = module_factory(self.obs_dim, self.vec.num_actions)
         self.rollout_length = rollout_length
         self.mode = mode
         self._rng = np.random.default_rng(seed + 1000)
@@ -45,16 +51,23 @@ class EnvRunner:
 
     def env_info(self) -> dict:
         return {
-            "observation_dim": self.vec.observation_dim,
+            "observation_dim": self.obs_dim,
             "num_actions": self.vec.num_actions,
         }
+
+    def get_state(self) -> dict:
+        return {"connectors": self.pipeline.state(), "epsilon": self.epsilon}
+
+    def set_state(self, state: dict) -> None:
+        self.pipeline.load_state(state["connectors"])
+        self.epsilon = state["epsilon"]
 
     def sample(self) -> dict:
         """One rollout of T steps across E envs."""
         if self._params is None:
             raise RuntimeError("set_weights must be called before sample()")
         T, E = self.rollout_length, self.vec.num_envs
-        obs_dim = self.vec.observation_dim
+        obs_dim = self.obs_dim
         batch = {
             "obs": np.empty((T, E, obs_dim), np.float32),
             "actions": np.empty((T, E), np.int32),
@@ -72,7 +85,7 @@ class EnvRunner:
         else:
             batch["next_obs"] = np.empty((T, E, obs_dim), np.float32)
         for t in range(T):
-            obs = self.vec.obs
+            obs = self.pipeline(self.vec.obs)
             batch["obs"][t] = obs
             if self.mode == "actor_critic":
                 actions, logp, values = self.module.sample_actions_np(
@@ -97,13 +110,19 @@ class EnvRunner:
             batch["terminateds"][t] = terms
             if self.mode == "actor_critic":
                 if dones.any():
-                    _, v_true = self.module.forward_np(self._params, true_next_obs)
+                    # peek: processed successor obs WITHOUT advancing
+                    # connector state (the real next pipeline step happens
+                    # on the auto-reset obs)
+                    proc_next = self.pipeline.peek(true_next_obs)
+                    _, v_true = self.module.forward_np(self._params, proc_next)
                     batch["bootstrap_values"][t] = np.where(dones, v_true, 0.0)
             else:
-                batch["next_obs"][t] = true_next_obs
+                batch["next_obs"][t] = self.pipeline.peek(true_next_obs)
+            self.pipeline.on_dones(dones)
         if self.mode == "actor_critic":
             # bootstrap values for the obs after the last step
-            _, last_values = self.module.forward_np(self._params, self.vec.obs)
+            _, last_values = self.module.forward_np(
+                self._params, self.pipeline.peek(self.vec.obs))
             batch["last_values"] = last_values.astype(np.float32)
         returns, lengths = self.vec.pop_episode_stats()
         batch["episode_returns"] = np.asarray(returns, np.float32)

@@ -1,0 +1,355 @@
+"""Multi-agent environments and training.
+
+Equivalent of the reference's multi-agent stack (reference:
+rllib/env/multi_agent_env.py:30 MultiAgentEnv — dict-keyed obs/action/reward
+spaces; policy mapping via config.multi_agent(policies=...,
+policy_mapping_fn=...) in algorithm_config.py; per-policy batches in
+rllib/evaluation/sample_batch_builder.py MultiAgentSampleBatchBuilder).
+
+TPU mapping: one jitted Learner PER POLICY (separate param pytrees, separate
+optimizers — the reference likewise keeps one optimizer per policy), rollout
+collection on CPU actors with per-policy [T, E*|agents|] static-shape
+batches so every learner update is jit-stable.
+
+Protocol simplifications vs the reference (documented, deliberate):
+- every agent observes and acts at EVERY step (no agents appearing or
+  disappearing mid-episode) — this is what keeps learner batch shapes
+  static for XLA;
+- a done agent's sub-episode auto-resets in place (recorded via its done
+  flag), so the vectorized runner never blocks on stragglers.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import compute_gae, ppo_loss
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.rl_module import ActorCriticModule
+
+
+class MultiAgentEnv:
+    """Dict-keyed multi-agent env protocol.
+
+    reset(seed) -> {agent_id: obs}
+    step({agent_id: action}) -> (obs_d, reward_d, terminated_d, truncated_d)
+    where terminated_d/truncated_d carry per-agent flags. Every agent is
+    present in every dict, every step.
+    """
+
+    agent_ids: List[str]
+    observation_dim: int
+    num_actions: int
+
+    def reset(self, seed: int | None = None) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, int]):
+        raise NotImplementedError
+
+
+class IndependentMultiEnv(MultiAgentEnv):
+    """N independent copies of a single-agent env presented as one
+    multi-agent env (each agent's sub-episode auto-resets on its own) —
+    the canonical smoke-test topology for policy mapping."""
+
+    def __init__(self, spec, n_agents: int = 2, seed: int = 0):
+        from ray_tpu.rllib.env import make_env
+
+        self.agent_ids = [f"agent_{i}" for i in range(n_agents)]
+        self._envs = {a: make_env(spec) for a in self.agent_ids}
+        first = self._envs[self.agent_ids[0]]
+        self.observation_dim = first.observation_dim
+        self.num_actions = first.num_actions
+        self._seed = seed
+
+    def reset(self, seed: int | None = None) -> Dict[str, np.ndarray]:
+        base = self._seed if seed is None else seed
+        return {
+            a: env.reset(seed=base + i)
+            for i, (a, env) in enumerate(self._envs.items())
+        }
+
+    def step(self, actions: Dict[str, int]):
+        obs_d, rew_d, term_d, trunc_d = {}, {}, {}, {}
+        for a, env in self._envs.items():
+            obs, r, term, trunc = env.step(actions[a])
+            if term or trunc:
+                obs = env.reset()
+            obs_d[a], rew_d[a] = obs, r
+            term_d[a], trunc_d[a] = term, trunc
+        return obs_d, rew_d, term_d, trunc_d
+
+
+class MultiAgentEnvRunner:
+    """Vectorized multi-agent rollouts grouped into per-policy batches."""
+
+    def __init__(self, env_spec, module_factories: Dict[str, Callable],
+                 policy_mapping_fn: Callable[[str], str],
+                 num_envs: int = 1, rollout_length: int = 64, seed: int = 0):
+        from ray_tpu.rllib.env import make_env  # accepts callables too
+
+        def make(spec):
+            env = spec() if callable(spec) else make_env(spec)
+            assert isinstance(env, MultiAgentEnv), env
+            return env
+
+        self.envs = [make(env_spec) for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        probe = self.envs[0]
+        self.agent_ids = list(probe.agent_ids)
+        self.policy_mapping_fn = policy_mapping_fn
+        # policy -> its agents, in a FIXED order (defines batch columns)
+        self.policy_agents: Dict[str, List[str]] = {}
+        for a in self.agent_ids:
+            self.policy_agents.setdefault(policy_mapping_fn(a), []).append(a)
+        self.modules = {
+            p: module_factories[p](probe.observation_dim, probe.num_actions)
+            for p in self.policy_agents
+        }
+        self.obs_dim = probe.observation_dim
+        self.num_actions = probe.num_actions
+        self._obs = [
+            env.reset(seed=seed + 97 * i) for i, env in enumerate(self.envs)
+        ]
+        self._rng = np.random.default_rng(seed + 1000)
+        self._params: Dict[str, dict] | None = None
+        # per-(env, agent) episode accounting
+        self._ep_ret = {(i, a): 0.0 for i in range(num_envs)
+                        for a in self.agent_ids}
+        self.completed_returns: list[float] = []
+
+    def env_info(self) -> dict:
+        return {
+            "observation_dim": self.obs_dim,
+            "num_actions": self.num_actions,
+            "policies": {p: list(ags) for p, ags in self.policy_agents.items()},
+        }
+
+    def set_weights(self, params_by_policy: Dict[str, dict]) -> None:
+        self._params = params_by_policy
+
+    def _stack_obs(self, policy: str) -> np.ndarray:
+        """[E * |agents_p|, D] — env-major, agent-minor column order."""
+        ags = self.policy_agents[policy]
+        return np.stack([self._obs[i][a]
+                         for i in range(self.num_envs) for a in ags])
+
+    def sample(self) -> Dict[str, dict]:
+        if self._params is None:
+            raise RuntimeError("set_weights must be called before sample()")
+        T, E = self.rollout_length, self.num_envs
+        out: Dict[str, dict] = {}
+        for p, ags in self.policy_agents.items():
+            C = E * len(ags)
+            out[p] = {
+                "obs": np.empty((T, C, self.obs_dim), np.float32),
+                "actions": np.empty((T, C), np.int32),
+                "logp": np.empty((T, C), np.float32),
+                "values": np.empty((T, C), np.float32),
+                "rewards": np.empty((T, C), np.float32),
+                "dones": np.empty((T, C), np.bool_),
+                "terminateds": np.empty((T, C), np.bool_),
+                "bootstrap_values": np.zeros((T, C), np.float32),
+            }
+        for t in range(T):
+            acts: list[dict] = [dict() for _ in range(E)]
+            for p, ags in self.policy_agents.items():
+                obs = self._stack_obs(p)
+                a, logp, v = self.modules[p].sample_actions_np(
+                    self._params[p], obs, self._rng
+                )
+                b = out[p]
+                b["obs"][t], b["actions"][t] = obs, a
+                b["logp"][t], b["values"][t] = logp, v
+                for c, (i, ag) in enumerate(
+                    (i, ag) for i in range(E) for ag in ags
+                ):
+                    acts[i][ag] = int(a[c])
+            results = [env.step(acts[i]) for i, env in enumerate(self.envs)]
+            for p, ags in self.policy_agents.items():
+                b = out[p]
+                for c, (i, ag) in enumerate(
+                    (i, ag) for i in range(E) for ag in ags
+                ):
+                    obs_d, rew_d, term_d, trunc_d = results[i]
+                    done = bool(term_d[ag] or trunc_d[ag])
+                    b["rewards"][t, c] = rew_d[ag]
+                    b["dones"][t, c] = done
+                    b["terminateds"][t, c] = term_d[ag]
+                    self._ep_ret[(i, ag)] += rew_d[ag]
+                    if done:
+                        self.completed_returns.append(self._ep_ret[(i, ag)])
+                        self._ep_ret[(i, ag)] = 0.0
+            # post-step obs (env-side auto-reset already applied) feeds the
+            # next action; truncated sub-episodes bootstrap from V(reset
+            # obs) — accepted simplification, built-in MA envs terminate
+            for i in range(E):
+                self._obs[i] = results[i][0]
+        for p in self.policy_agents:
+            b = out[p]
+            _, last_v = self.modules[p].forward_np(
+                self._params[p], self._stack_obs(p)
+            )
+            b["last_values"] = last_v.astype(np.float32)
+            rets = self.completed_returns
+            b["episode_returns"] = np.asarray(rets, np.float32)
+            b["episode_lengths"] = np.zeros(len(rets), np.int64)
+        self.completed_returns = []
+        return out
+
+
+class MultiAgentPPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.gae_lambda = 0.95
+        self.policies: List[str] = ["default_policy"]
+        self.policy_mapping_fn: Callable[[str], str] = (
+            lambda agent_id: "default_policy"
+        )
+        self.algo_class = MultiAgentPPO
+
+    def multi_agent(self, policies: List[str] | None = None,
+                    policy_mapping_fn: Callable | None = None
+                    ) -> "MultiAgentPPOConfig":
+        if policies is not None:
+            self.policies = list(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+
+class MultiAgentPPO(Algorithm):
+    """PPO over per-policy batches: one Learner per policy."""
+
+    def _setup(self) -> None:
+        cfg = self.config
+        hidden = tuple(cfg.hidden)
+        factories = {
+            p: (lambda od, na, h=hidden: ActorCriticModule(od, na, h))
+            for p in cfg.policies
+        }
+        if cfg.num_env_runners > 0:
+            import ray_tpu
+
+            runner_cls = ray_tpu.remote(num_cpus=1)(MultiAgentEnvRunner)
+            self._runners = [
+                runner_cls.remote(
+                    cfg.env_spec, factories, cfg.policy_mapping_fn,
+                    num_envs=cfg.num_envs_per_runner,
+                    rollout_length=cfg.rollout_length,
+                    seed=cfg.seed + 1 + i,
+                )
+                for i in range(cfg.num_env_runners)
+            ]
+            info = ray_tpu.get(self._runners[0].env_info.remote(), timeout=120)
+        else:
+            self._local_runner = MultiAgentEnvRunner(
+                cfg.env_spec, factories, cfg.policy_mapping_fn,
+                num_envs=cfg.num_envs_per_runner,
+                rollout_length=cfg.rollout_length,
+                seed=cfg.seed,
+            )
+            info = self._local_runner.env_info()
+        self.obs_dim = info["observation_dim"]
+        self.num_actions = info["num_actions"]
+        self._rng = np.random.default_rng(cfg.seed + 7)
+        self.learners: Dict[str, Learner] = {}
+        for j, p in enumerate(cfg.policies):
+            module = ActorCriticModule(self.obs_dim, self.num_actions,
+                                       cfg.hidden)
+            self.learners[p] = Learner(
+                module,
+                ppo_loss,
+                config={
+                    "clip_param": cfg.clip_param,
+                    "vf_loss_coeff": cfg.vf_loss_coeff,
+                    "entropy_coeff": cfg.entropy_coeff,
+                },
+                learning_rate=cfg.lr,
+                max_grad_norm=cfg.max_grad_norm,
+                mesh=cfg.mesh,
+                seed=cfg.seed + 31 * j,  # per-policy init (self-play asym.)
+            )
+        self._broadcast()
+
+    # base-class helpers that assume a single learner
+    @property
+    def learner(self):  # save_state/load_state compatibility
+        class _Multi:
+            def __init__(s, learners):
+                s._l = learners
+
+            def state(s):
+                return {p: l.state() for p, l in s._l.items()}
+
+            def load_state(s, st):
+                for p, l in s._l.items():
+                    l.load_state(st[p])
+
+        return _Multi(self.learners)
+
+    def _broadcast(self) -> None:
+        w = {p: l.get_weights_np() for p, l in self.learners.items()}
+        if self._local_runner is not None:
+            self._local_runner.set_weights(w)
+        else:
+            import ray_tpu
+
+            ray_tpu.get([r.set_weights.remote(w) for r in self._runners],
+                        timeout=120)
+
+    def _sample_ma(self) -> List[Dict[str, dict]]:
+        if self._local_runner is not None:
+            samples = [self._local_runner.sample()]
+        else:
+            import ray_tpu
+
+            samples = ray_tpu.get([r.sample.remote() for r in self._runners],
+                                  timeout=300)
+        for s in samples:
+            first = next(iter(s.values()))
+            self._recent_returns.extend(first["episode_returns"].tolist())
+            self._recent_returns = self._recent_returns[-100:]
+            self._total_env_steps += first["rewards"].size
+        return samples
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        samples = self._sample_ma()
+        metrics: dict = {}
+        for p, learner in self.learners.items():
+            flat = {"obs": [], "actions": [], "logp_old": [],
+                    "advantages": [], "value_targets": []}
+            for s in samples:
+                b = s[p]
+                adv, ret = compute_gae(b, cfg.gamma, cfg.gae_lambda)
+                T, C = b["rewards"].shape
+                flat["obs"].append(b["obs"].reshape(T * C, -1))
+                flat["actions"].append(b["actions"].reshape(-1))
+                flat["logp_old"].append(b["logp"].reshape(-1))
+                flat["advantages"].append(adv.reshape(-1))
+                flat["value_targets"].append(ret.reshape(-1))
+            train = {k: np.concatenate(v) for k, v in flat.items()}
+            a = train["advantages"]
+            train["advantages"] = (a - a.mean()) / (a.std() + 1e-8)
+            n = len(train["actions"])
+            mb = min(cfg.minibatch_size, n)
+            acc: dict[str, list[float]] = {}
+            for _ in range(cfg.num_epochs):
+                perm = self._rng.permutation(n)
+                for start in range(0, n - mb + 1, mb):
+                    idx = perm[start:start + mb]
+                    m = learner.update({k: v[idx] for k, v in train.items()})
+                    for k, v in m.items():
+                        acc.setdefault(k, []).append(v)
+            for k, v in acc.items():
+                metrics[f"{p}/{k}"] = float(np.mean(v))
+        self._broadcast()
+        return metrics

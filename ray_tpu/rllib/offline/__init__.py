@@ -1,0 +1,24 @@
+"""ray_tpu.rllib.offline — experience file IO + offline algorithms.
+
+Equivalent of the reference's offline stack (reference: rllib/offline/ —
+json_reader/json_writer/dataset_reader; offline algorithms under
+rllib/algorithms/marwil, /bc).
+"""
+from ray_tpu.rllib.offline.io import (
+    DatasetReader,
+    JsonReader,
+    JsonWriter,
+    compute_returns,
+)
+from ray_tpu.rllib.offline.marwil import BC, BCConfig, MARWIL, MARWILConfig
+
+__all__ = [
+    "BC",
+    "BCConfig",
+    "DatasetReader",
+    "JsonReader",
+    "JsonWriter",
+    "MARWIL",
+    "MARWILConfig",
+    "compute_returns",
+]

@@ -1,0 +1,158 @@
+"""Offline sample IO — JSONL experience files and dataset readers.
+
+Equivalent of the reference's offline IO (reference: rllib/offline/
+json_writer.py, json_reader.py, dataset_reader.py — experiences written as
+row-chunk files consumable by offline algorithms and replay seeding). Rows
+here are per-TRANSITION dicts carrying an `eps_id` so readers can regroup
+episodes and compute returns; `done` marks episode ends.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+class JsonWriter:
+    """Append rollout batches ([T, E, ...] dicts from EnvRunner.sample) or
+    single transitions to a JSONL file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a")
+        # per-env episode counters so eps_ids stay unique across batches
+        self._eps_base = 0
+        self._eps_cur: dict[int, int] = {}
+
+    def write_batch(self, batch: dict) -> int:
+        """Flatten one [T, E] rollout batch into transition rows."""
+        T, E = batch["rewards"].shape
+        n = 0
+        for e in range(E):
+            if e not in self._eps_cur:
+                self._eps_cur[e] = self._alloc_eps()
+            for t in range(T):
+                row = {
+                    "eps_id": self._eps_cur[e],
+                    "obs": batch["obs"][t, e].tolist(),
+                    "action": int(batch["actions"][t, e]),
+                    "reward": float(batch["rewards"][t, e]),
+                    "done": bool(batch["dones"][t, e]),
+                    "terminated": bool(batch["terminateds"][t, e]),
+                }
+                if "logp" in batch:
+                    row["logp"] = float(batch["logp"][t, e])
+                self._f.write(json.dumps(row) + "\n")
+                n += 1
+                if row["done"]:
+                    self._eps_cur[e] = self._alloc_eps()
+        self._f.flush()
+        return n
+
+    def write_transition(self, eps_id: int, obs, action: int, reward: float,
+                         done: bool, terminated: Optional[bool] = None,
+                         **extra) -> None:
+        row = {
+            "eps_id": int(eps_id),
+            "obs": np.asarray(obs, np.float32).tolist(),
+            "action": int(action),
+            "reward": float(reward),
+            "done": bool(done),
+            "terminated": bool(done if terminated is None else terminated),
+        }
+        row.update(extra)
+        self._f.write(json.dumps(row) + "\n")
+
+    def _alloc_eps(self) -> int:
+        self._eps_base += 1
+        return self._eps_base - 1
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class JsonReader:
+    """Read a JSONL experience file (or a directory of them)."""
+
+    def __init__(self, path: str):
+        if os.path.isdir(path):
+            self.files = sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if f.endswith((".json", ".jsonl"))
+            )
+        else:
+            self.files = [path]
+
+    def iter_rows(self) -> Iterator[dict]:
+        for f in self.files:
+            with open(f) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)
+
+    def episodes(self) -> List[List[dict]]:
+        """Group rows into episodes by eps_id (file order preserved
+        within an episode)."""
+        by_id: dict[int, List[dict]] = {}
+        for row in self.iter_rows():
+            by_id.setdefault(row["eps_id"], []).append(row)
+        return list(by_id.values())
+
+
+class DatasetReader:
+    """Adapter: a ray_tpu.data.Dataset with the same row schema acts as an
+    offline input (reference: rllib/offline/dataset_reader.py)."""
+
+    def __init__(self, dataset):
+        self._ds = dataset
+
+    def iter_rows(self) -> Iterator[dict]:
+        for row in self._ds.iter_rows():
+            row = dict(row)
+            obs = row["obs"]
+            row["obs"] = (obs.tolist() if isinstance(obs, np.ndarray) else
+                          list(obs))
+            yield row
+
+    def episodes(self) -> List[List[dict]]:
+        by_id: dict[int, List[dict]] = {}
+        for row in self.iter_rows():
+            by_id.setdefault(int(row["eps_id"]), []).append(row)
+        return list(by_id.values())
+
+
+def compute_returns(episodes: List[List[dict]], gamma: float):
+    """Per-transition discounted return-to-go. Episodes whose last row isn't
+    `done` (truncated files) get dropped-tail treatment: their rows are kept
+    but the return bootstraps from 0 — standard MC treatment of incomplete
+    trails (reference MARWIL postprocesses with GAE when a value net exists;
+    pure MC here keeps the offline path model-free)."""
+    obs, actions, returns = [], [], []
+    for ep in episodes:
+        g = 0.0
+        rets = np.empty(len(ep), np.float32)
+        for i in range(len(ep) - 1, -1, -1):
+            g = ep[i]["reward"] + gamma * g
+            rets[i] = g
+        for i, row in enumerate(ep):
+            obs.append(row["obs"])
+            actions.append(row["action"])
+            returns.append(rets[i])
+    return (
+        np.asarray(obs, np.float32),
+        np.asarray(actions, np.int32),
+        np.asarray(returns, np.float32),
+    )

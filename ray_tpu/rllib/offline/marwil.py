@@ -1,0 +1,158 @@
+"""MARWIL / BC — offline policy learning from experience files.
+
+Equivalent of the reference's MARWIL and BC (reference:
+rllib/algorithms/marwil/marwil.py — advantage-weighted behavior cloning,
+Wang et al. 2018; rllib/algorithms/bc/bc.py is MARWIL with beta=0, the same
+subclass relationship used here). No environment is stepped: batches come
+from a JsonReader / DatasetReader; the loss is a jitted advantage-weighted
+cross-entropy plus a Monte-Carlo value regression.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.offline.io import (
+    DatasetReader,
+    JsonReader,
+    compute_returns,
+)
+from ray_tpu.rllib.rl_module import ActorCriticModule
+
+
+def marwil_loss(module, params, batch, config):
+    """-logp(a|s) * exp(beta * A_norm) + c_vf * (V - R)^2 (pure jax).
+
+    beta=0 reduces exactly to behavior cloning (the exp weight is 1 and the
+    value head trains but does not influence the policy term)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits, values = module.forward(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+    adv = batch["returns"] - jax.lax.stop_gradient(values)
+    adv_norm = adv / (jnp.std(adv) + 1e-8)
+    weight = jnp.exp(jnp.clip(config["beta"] * adv_norm, -10.0, 10.0))
+    policy_loss = -jnp.mean(jax.lax.stop_gradient(weight) * logp)
+    value_loss = jnp.mean(jnp.square(values - batch["returns"]))
+    total = policy_loss + config["vf_coeff"] * value_loss
+    return total, {
+        "policy_loss": policy_loss,
+        "vf_loss": value_loss,
+        "mean_weight": jnp.mean(weight),
+    }
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        self.input_ = None  # path / JsonReader / DatasetReader / Dataset
+        self.observation_dim = None  # inferred from data when None
+        self.num_actions = None
+        self.algo_class = MARWIL
+
+    def offline_data(self, input_=None, beta=None) -> "MARWILConfig":
+        if input_ is not None:
+            self.input_ = input_
+        if beta is not None:
+            self.beta = beta
+        return self
+
+    def environment(self, env=None, *, observation_dim=None,
+                    num_actions=None) -> "MARWILConfig":
+        if env is not None:
+            self.env_spec = env
+        if observation_dim is not None:
+            self.observation_dim = observation_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+
+class MARWIL(Algorithm):
+    """Offline-only Algorithm: `_setup` loads the data instead of spawning
+    EnvRunners; `train()` runs minibatch epochs over it."""
+
+    def _setup(self) -> None:
+        cfg = self.config
+        reader = cfg.input_
+        if isinstance(reader, str):
+            reader = JsonReader(reader)
+        elif reader is not None and not hasattr(reader, "episodes"):
+            reader = DatasetReader(reader)  # a Dataset
+        if reader is None:
+            raise ValueError("MARWIL/BC requires config.offline_data(input_=...)")
+        episodes = reader.episodes()
+        self._obs, self._actions, self._returns = compute_returns(
+            episodes, cfg.gamma
+        )
+        if len(self._actions) == 0:
+            raise ValueError("offline input is empty")
+        self.obs_dim = (cfg.observation_dim
+                        or int(self._obs.shape[1]))
+        self.num_actions = (cfg.num_actions
+                            or int(self._actions.max()) + 1)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._build_learner()
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        module = ActorCriticModule(self.obs_dim, self.num_actions, cfg.hidden)
+        self.learner = Learner(
+            module,
+            marwil_loss,
+            config={"beta": cfg.beta, "vf_coeff": cfg.vf_coeff},
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n = len(self._actions)
+        mb = min(cfg.minibatch_size, n)
+        metrics_acc: dict[str, list[float]] = {}
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start:start + mb]
+                m = self.learner.update({
+                    "obs": self._obs[idx],
+                    "actions": self._actions[idx],
+                    "returns": self._returns[idx],
+                })
+                for k, v in m.items():
+                    metrics_acc.setdefault(k, []).append(v)
+        return {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+
+    # offline algos sample no env steps
+    def _sample_all(self):  # pragma: no cover - not used
+        raise RuntimeError("offline algorithm does not sample")
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        """Greedy action for evaluation."""
+        w = self.learner.get_weights_np()
+        logits, _ = self.learner.module.forward_np(
+            w, np.asarray(obs, np.float32)[None]
+        )
+        return int(np.argmax(logits[0]))
+
+
+class BCConfig(MARWILConfig):
+    """Behavior cloning = MARWIL with beta=0 (the reference's exact
+    relationship, rllib/algorithms/bc/bc.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self.beta = 0.0
+        self.vf_coeff = 0.0  # pure imitation: value head untouched
+        self.algo_class = BC
+
+
+class BC(MARWIL):
+    pass

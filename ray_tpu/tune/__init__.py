@@ -6,6 +6,7 @@ search/). Trials are actors on the distributed core; TPU trials reserve
 chips via trial resources so concurrent trials never share a chip.
 """
 from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
@@ -16,6 +17,7 @@ from ray_tpu.tune.search import (
     SuggestAdapter,
     BasicVariantGenerator,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -35,6 +37,7 @@ from ray_tpu.tune.tuner import (
 
 __all__ = [
     "SuggestAdapter",
+    "ASHAScheduler",
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
     "FIFOScheduler",
@@ -51,9 +54,16 @@ __all__ = [
     "choice",
     "get_checkpoint",
     "grid_search",
+    "TPESearcher",
     "loguniform",
     "randint",
     "report",
     "sample_from",
     "uniform",
 ]
+
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("tune")
+del _rlu

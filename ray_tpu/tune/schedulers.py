@@ -210,3 +210,8 @@ class PopulationBasedTraining(TrialScheduler):
                 else:
                     new[key] = min(hi, max(lo, new[key] * self.rng.choice([0.8, 1.2])))
         return new
+
+
+# Public alias matching the reference's preferred name (reference:
+# tune/schedulers/__init__.py exports ASHAScheduler = AsyncHyperBandScheduler)
+ASHAScheduler = AsyncHyperBandScheduler

@@ -178,6 +178,181 @@ class SuggestAdapter(Searcher):
             pass  #                         not take down the experiment
 
 
+class TPESearcher(Searcher):
+    """Native Tree-structured Parzen Estimator searcher (Bergstra et al.
+    2011) — the model behind Optuna's default sampler and HyperOpt
+    (reference integrates those externally via tune/search/optuna/,
+    tune/search/hyperopt/; this is an in-tree implementation with no
+    dependency, pluggable exactly like them).
+
+    Observations are split at the gamma-quantile into good/bad sets; each
+    numeric dimension gets a Parzen (Gaussian-mixture) density per set, and
+    candidates drawn from the good density are ranked by the likelihood
+    ratio l(x)/g(x). Categorical dims use add-one-smoothed frequencies.
+    Until n_startup completions it falls back to random sampling.
+
+    Compose with ASHA for BOHB-style search (model-based suggestions +
+    successive-halving early stopping): Tuner(tune_config=TuneConfig(
+    searcher=TPESearcher(...), scheduler=ASHAScheduler(...))).
+    """
+
+    def __init__(self, param_space: dict, *, metric: str | None = None,
+                 mode: str | None = None, n_startup: int = 10,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 max_trials: int | None = None, seed: int | None = None):
+        grids, others = _split_spec(param_space)
+        if grids:
+            raise ValueError("TPESearcher does not accept grid_search "
+                             "domains; use BasicVariantGenerator")
+        self._dims: list[tuple[tuple, Any]] = []  # (path, Domain) to model
+        self._fixed: list[tuple[tuple, Any]] = []
+        self._deferred: list[tuple[tuple, SampleFrom]] = []
+        for path, v in others:
+            if isinstance(v, SampleFrom):
+                self._deferred.append((path, v))
+            elif isinstance(v, Domain):
+                self._dims.append((path, v))
+            else:
+                self._fixed.append((path, v))
+        self.metric, self.mode = metric, mode
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._max_trials = max_trials
+        self._suggested = 0
+        self.rng = random.Random(seed)
+        self._live: dict[str, dict] = {}
+        self._obs: list[tuple[dict, float]] = []  # (flat values, score)
+
+    def set_search_properties(self, metric, mode):
+        if self.metric is None:
+            self.metric = metric
+        if self.mode is None:
+            self.mode = mode
+
+    # -- per-dimension densities ------------------------------------------
+
+    @staticmethod
+    def _to_unit(domain, x: float) -> float:
+        if isinstance(domain, LogUniform):
+            lo, hi = math.log(domain.low), math.log(domain.high)
+            return (math.log(x) - lo) / (hi - lo)
+        return (x - domain.low) / (domain.high - domain.low)
+
+    @staticmethod
+    def _from_unit(domain, u: float):
+        u = min(max(u, 0.0), 1.0)
+        if isinstance(domain, LogUniform):
+            lo, hi = math.log(domain.low), math.log(domain.high)
+            return math.exp(lo + u * (hi - lo))
+        x = domain.low + u * (domain.high - domain.low)
+        if isinstance(domain, Randint):
+            return min(int(x), domain.high - 1)
+        return x
+
+    def _parzen(self, units: list[float]):
+        """(centers, bandwidth) in unit space; uniform prior as an extra
+        pseudo-center keeps exploration alive."""
+        n = len(units)
+        bw = max(1.0 / (1 + n) ** 0.5 * 0.5, 0.05)
+        return units, bw
+
+    def _sample_parzen(self, centers, bw) -> float:
+        c = self.rng.choice(centers) if centers else self.rng.random()
+        return self.rng.gauss(c, bw)
+
+    @staticmethod
+    def _parzen_pdf(u: float, centers, bw) -> float:
+        # mixture of gaussians + a uniform component (weight 1/(n+1))
+        n = len(centers)
+        if n == 0:
+            return 1.0
+        s = 0.0
+        for c in centers:
+            s += math.exp(-0.5 * ((u - c) / bw) ** 2) / (bw * 2.5066282746)
+        return (s + 1.0) / (n + 1)
+
+    # -- suggest/observe ---------------------------------------------------
+
+    def _random_config(self) -> dict:
+        flat = {path: d.sample(self.rng) for path, d in self._dims}
+        return flat
+
+    def _tpe_config(self) -> dict:
+        scored = sorted(self._obs, key=lambda o: -o[1])
+        n_good = max(1, int(self.gamma * len(scored)))
+        good, bad = scored[:n_good], scored[n_good:]
+        flat: dict = {}
+        for path, d in self._dims:
+            if isinstance(d, Choice):
+                k = len(d.categories)
+                def probs(obs):
+                    counts = [1.0] * k
+                    for cfg, _ in obs:
+                        counts[d.categories.index(cfg[path])] += 1.0
+                    t = sum(counts)
+                    return [c / t for c in counts]
+                pg, pb = probs(good), probs(bad)
+                best_i = max(
+                    range(k),
+                    key=lambda i: (pg[i] / pb[i]) if pb[i] > 0 else pg[i],
+                )
+                # sample from good-probabilities but biased to the best ratio
+                if self.rng.random() < 0.8:
+                    flat[path] = d.categories[best_i]
+                else:
+                    r, acc = self.rng.random(), 0.0
+                    for i, p in enumerate(pg):
+                        acc += p
+                        if r <= acc:
+                            flat[path] = d.categories[i]
+                            break
+                    else:
+                        flat[path] = d.categories[-1]
+                continue
+            gu = [self._to_unit(d, cfg[path]) for cfg, _ in good]
+            bu = [self._to_unit(d, cfg[path]) for cfg, _ in bad]
+            gc, gbw = self._parzen(gu)
+            bc, bbw = self._parzen(bu)
+            best_u, best_ratio = None, -1.0
+            for _ in range(self.n_candidates):
+                u = self._sample_parzen(gc, gbw)
+                ratio = (self._parzen_pdf(u, gc, gbw)
+                         / max(self._parzen_pdf(u, bc, bbw), 1e-12))
+                if ratio > best_ratio:
+                    best_u, best_ratio = u, ratio
+            flat[path] = self._from_unit(d, best_u)
+        return flat
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._max_trials is not None and self._suggested >= self._max_trials:
+            return None
+        self._suggested += 1
+        if len(self._obs) < self.n_startup:
+            flat = self._random_config()
+        else:
+            flat = self._tpe_config()
+        cfg: dict = {}
+        for path, v in self._fixed:
+            _set_path(cfg, path, v)
+        for path, v in flat.items():
+            _set_path(cfg, path, v)
+        for path, v in self._deferred:
+            _set_path(cfg, path, v.fn(cfg))
+        self._live[trial_id] = flat
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False) -> None:
+        flat = self._live.pop(trial_id, None)
+        if flat is None or error or result is None or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._obs.append((flat, score))
+
+
 class BasicVariantGenerator(Searcher):
     """Grid x random expansion: the cross-product of all grid_search values,
     repeated num_samples times with random domains re-sampled per repeat."""
