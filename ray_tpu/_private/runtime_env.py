@@ -1,10 +1,9 @@
 """Runtime environments: per-task/actor env application.
 
-Equivalent of the reference's runtime_env subsystem, narrowed to the
-single-host fields (reference: python/ray/runtime_env/ +
-python/ray/_private/runtime_env/ — plugin base plugin.py:264; the
-conda/pip/container plugins need an agent + package store and are out of
-scope this round; design doc python/ray/runtime_env/ARCHITECTURE.md).
+Equivalent of the reference's runtime_env subsystem (reference:
+python/ray/runtime_env/ + python/ray/_private/runtime_env/ — plugin base
+plugin.py:264, pip plugin pip.py; design doc
+python/ray/runtime_env/ARCHITECTURE.md).
 
 Supported fields:
   * env_vars: {name: value} — set for the task's duration (actor lifetime
@@ -12,14 +11,26 @@ Supported fields:
   * working_dir: local directory — cwd for the task's duration. Local path
     only (the reference ships zips through its GCS package store).
   * py_modules: list of local dirs prepended to sys.path.
+  * pip: list of requirement specs, or {"packages": [...],
+    "pip_install_options": [...]} — materialized ONCE per unique spec as a
+    content-addressed venv under RAY_TPU_RUNTIME_ENV_DIR
+    (~/.ray_tpu/runtime_envs by default) whose site-packages is injected
+    onto sys.path for the task. Deviation from the reference (pip.py swaps
+    the worker's interpreter for the venv python): injection keeps the
+    already-warm worker process — and its loaded jax/XLA runtime — alive,
+    which matters on TPU where backend re-init costs seconds.
 """
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
 import os
+import subprocess
 import sys
+import time
 
-_KNOWN = {"env_vars", "working_dir", "py_modules"}
+_KNOWN = {"env_vars", "working_dir", "py_modules", "pip"}
 
 
 def validate_runtime_env(env: dict | None) -> None:
@@ -34,6 +45,132 @@ def validate_runtime_env(env: dict | None) -> None:
     wd = env.get("working_dir")
     if wd is not None and not os.path.isdir(wd):
         raise ValueError(f"runtime_env working_dir {wd!r} is not a directory")
+    pip = env.get("pip")
+    if pip is not None:
+        if isinstance(pip, dict):
+            if "packages" not in pip:
+                raise ValueError('runtime_env pip dict needs a "packages" key')
+        elif not isinstance(pip, (list, tuple)):
+            raise ValueError("runtime_env pip must be a list or dict")
+
+
+# ---------------------------------------------------------------------------
+# pip venvs — content-addressed, created once, shared by all workers
+# ---------------------------------------------------------------------------
+
+
+def _runtime_env_root() -> str:
+    return os.environ.get(
+        "RAY_TPU_RUNTIME_ENV_DIR",
+        os.path.join(os.path.expanduser("~"), ".ray_tpu", "runtime_envs"),
+    )
+
+
+def _pip_spec(pip) -> tuple[list[str], list[str]]:
+    if isinstance(pip, dict):
+        return list(pip["packages"]), list(pip.get("pip_install_options", []))
+    return list(pip), []
+
+
+def ensure_pip_env(pip) -> str:
+    """Create (or reuse) the venv for this pip spec; returns its
+    site-packages directory. Concurrent creators race on an atomic mkdir;
+    losers wait for the winner's .ready marker."""
+    packages, options = _pip_spec(pip)
+    key = hashlib.sha1(
+        json.dumps([packages, options, sys.version_info[:2]],
+                   sort_keys=True).encode()
+    ).hexdigest()[:16]
+    env_dir = os.path.join(_runtime_env_root(), "pip", key)
+    ready = os.path.join(env_dir, ".ready")
+    site = os.path.join(
+        env_dir, "lib",
+        f"python{sys.version_info[0]}.{sys.version_info[1]}", "site-packages",
+    )
+    if os.path.exists(ready):
+        return site
+    os.makedirs(os.path.dirname(env_dir), exist_ok=True)
+    lock_dir = env_dir + ".lock"
+    failed = os.path.join(env_dir, ".failed")
+    try:
+        os.mkdir(lock_dir)  # atomic: we are the creator
+    except FileExistsError:
+        deadline = time.monotonic() + 300
+        while not os.path.exists(ready):
+            if os.path.exists(failed):
+                with open(failed) as f:
+                    raise RuntimeError(
+                        f"pip runtime_env {key} failed to build: {f.read()}")
+            # a creator killed mid-install leaves the lock forever: steal
+            # stale locks (no .ready/.failed and no mtime progress) and
+            # retry the build ourselves
+            try:
+                age = time.time() - os.path.getmtime(lock_dir)
+            except OSError:
+                age = 0.0  # lock vanished: winner just finished/cleaned up
+            if age > 600:
+                with contextlib.suppress(OSError):
+                    os.rmdir(lock_dir)
+                return ensure_pip_env(pip)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pip runtime_env {key} not ready after 300s")
+            time.sleep(0.2)
+        return site
+    try:
+        # --system-site-packages: jax/numpy stay importable (reference pip
+        # plugin default); venv pip itself installs only the requested specs
+        with contextlib.suppress(OSError):
+            os.remove(failed)  # we are rebuilding after a prior failure
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--clear",
+             "--system-site-packages", env_dir],
+            check=True, capture_output=True,
+        )
+        # when THIS interpreter is itself a venv, --system-site-packages
+        # exposes the base python, not our site-packages — bridge them in
+        # with a .pth so build backends (setuptools) resolve inside the env
+        os.makedirs(site, exist_ok=True)
+        parent_sites = [p for p in sys.path if p.endswith("site-packages")]
+        if parent_sites:
+            with open(os.path.join(site, "_parent_site.pth"), "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+        vpy = os.path.join(env_dir, "bin", "python")
+        cmd = [vpy, "-m", "pip", "install", "--no-warn-script-location",
+               *options, *packages]
+        # touch the lock while pip runs so waiters see mtime progress and
+        # never steal the lock from a live (just slow) build; output goes
+        # to a log file (a PIPE left undrained deadlocks chatty installs)
+        log_path = os.path.join(env_dir, "pip_install.log")
+        with open(log_path, "w") as log:
+            proc = subprocess.Popen(cmd, stdout=log,
+                                    stderr=subprocess.STDOUT, text=True)
+            while True:
+                try:
+                    rc = proc.wait(timeout=30)
+                    break
+                except subprocess.TimeoutExpired:
+                    with contextlib.suppress(OSError):
+                        os.utime(lock_dir)
+        if rc != 0:
+            with open(log_path) as f:
+                tail = f.read()[-2000:]
+            raise RuntimeError(
+                f"pip install failed for runtime_env {packages}: {tail}")
+        with open(ready, "w") as f:
+            f.write(json.dumps({"packages": packages, "options": options}))
+        return site
+    except BaseException as e:
+        # leave a breadcrumb so concurrent waiters fail fast with the real
+        # error instead of burning their full timeout
+        with contextlib.suppress(OSError):
+            os.makedirs(env_dir, exist_ok=True)
+            with open(failed, "w") as f:
+                f.write(str(e)[:2000])
+        raise
+    finally:
+        with contextlib.suppress(OSError):
+            os.rmdir(lock_dir)
 
 
 @contextlib.contextmanager
@@ -54,7 +191,9 @@ def applied_runtime_env(env: dict | None, *, permanent: bool = False):
     if wd:
         saved_cwd = os.getcwd()
         os.chdir(wd)
-    mods = env.get("py_modules") or []
+    mods = list(env.get("py_modules") or [])
+    if env.get("pip"):
+        mods.append(ensure_pip_env(env["pip"]))
     if mods:
         saved_path = list(sys.path)
         for m in reversed(mods):

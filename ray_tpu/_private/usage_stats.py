@@ -1,0 +1,87 @@
+"""Usage stats — opt-in, LOCAL-ONLY usage reporting.
+
+Equivalent of the reference's usage-stats subsystem (reference:
+python/ray/_private/usage/usage_lib.py — schema of cluster metadata +
+library-usage tags collected at shutdown). Deliberate deviation: the
+reference POSTs the report to a collection server; this implementation
+writes it to `<session_dir>/usage_stats.json` and NOWHERE else. There is no
+network path in or out — operators who want fleet telemetry ship the file
+themselves. Default remains OFF (RAY_TPU_USAGE_STATS_ENABLED=1 to enable),
+matching the reference's env-var gate (usage_constant.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import platform
+import threading
+import time
+from typing import Optional
+
+_SCHEMA_VERSION = "0.1"
+_lock = threading.Lock()
+_library_usages: set[str] = set()
+_extra_tags: dict[str, str] = {}
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "0") == "1"
+
+
+def record_library_usage(library: str) -> None:
+    """Called by library entry points (data/train/tune/serve/rllib) —
+    no-op unless stats are enabled (reference: record_library_usage)."""
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _library_usages.add(library)
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _extra_tags[str(key)] = str(value)
+
+
+def _collect(worker=None) -> dict:
+    import ray_tpu
+
+    report = {
+        "schema_version": _SCHEMA_VERSION,
+        "source": "ray_tpu",
+        "ray_tpu_version": ray_tpu.__version__,
+        "python_version": platform.python_version(),
+        "os": platform.system().lower(),
+        "collect_timestamp_ms": int(time.time() * 1000),
+        "libraries_used": sorted(_library_usages),
+        "extra_usage_tags": dict(_extra_tags),
+    }
+    try:
+        resources = ray_tpu.cluster_resources()
+        report["total_num_cpus"] = int(resources.get("CPU", 0))
+        report["total_num_tpus"] = int(resources.get("TPU", 0))
+        report["total_num_nodes"] = len(ray_tpu.nodes())
+    except Exception:  # noqa: BLE001 — collection must never fail a shutdown
+        pass
+    return report
+
+
+def write_report(session_dir: Optional[str]) -> Optional[str]:
+    """Write the usage report into the session dir (called at node
+    shutdown). Returns the path, or None when disabled/no session."""
+    if not usage_stats_enabled() or not session_dir:
+        return None
+    try:
+        path = os.path.join(session_dir, "usage_stats.json")
+        with open(path, "w") as f:
+            json.dump(_collect(), f, indent=2, sort_keys=True)
+        return path
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        _library_usages.clear()
+        _extra_tags.clear()

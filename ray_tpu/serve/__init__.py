@@ -47,3 +47,9 @@ __all__ = [
     "start",
     "status",
 ]
+
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("serve")
+del _rlu

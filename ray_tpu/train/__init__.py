@@ -23,3 +23,9 @@ __all__ = [
     "get_dataset_shard",
     "report",
 ]
+
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("train")
+del _rlu

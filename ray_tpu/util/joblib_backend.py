@@ -1,0 +1,112 @@
+"""joblib parallel backend on the ray_tpu task core.
+
+Equivalent of the reference's joblib integration (reference:
+python/ray/util/joblib/__init__.py register_ray() +
+ray_backend.py RayBackend) — lets scikit-learn-style code run its
+`joblib.Parallel` batches as distributed tasks:
+
+    import joblib
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=4)(delayed(f)(i) for i in range(100))
+
+Each joblib batch (a picklable BatchedCalls callable) becomes one task;
+results are retrieved through a future-like wrapper so joblib's retrieval
+machinery (timeouts, callbacks) works unchanged.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import ray_tpu
+
+
+class _RefResult:
+    """AsyncResult-shaped wrapper over an ObjectRef; the callback (joblib's
+    batch-completion accounting) fires from a waiter thread."""
+
+    def __init__(self, ref, callback: Optional[Callable]):
+        self._ref = ref
+        if callback is not None:
+            def waiter():
+                try:
+                    out = ray_tpu.get(ref)
+                except Exception:  # noqa: BLE001 — joblib re-raises via get()
+                    return
+                callback(out)
+
+            t = threading.Thread(target=waiter, daemon=True)
+            t.start()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+
+@ray_tpu.remote
+def _run_batch(batch: Any) -> Any:
+    return batch()
+
+
+class RayTpuBackend:
+    """joblib ParallelBackendBase implementation (duck-typed subclass built
+    lazily so importing this module never hard-requires joblib)."""
+
+
+def _make_backend_class():
+    from joblib._parallel_backends import ParallelBackendBase
+
+    class _Backend(ParallelBackendBase):
+        supports_timeout = True
+        supports_retrieve_callback = True
+        uses_threads = False
+        supports_sharedmem = False
+
+        def configure(self, n_jobs=1, parallel=None, **backend_kwargs):
+            self.parallel = parallel
+            self._n_jobs = self.effective_n_jobs(n_jobs)
+            return self._n_jobs
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            if n_jobs and n_jobs > 0:
+                return n_jobs
+            try:
+                return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+            except Exception:  # noqa: BLE001 — not initialized yet
+                return 1
+
+        def apply_async(self, func, callback=None):
+            return _RefResult(_run_batch.remote(func), callback)
+
+        # joblib >= 1.3 retrieval path
+        def submit(self, func, callback=None):
+            return self.apply_async(func, callback)
+
+        def retrieve_result_callback(self, out):
+            return out
+
+        def retrieve_result(self, out, timeout=None):
+            return out.get(timeout=timeout)
+
+        def abort_everything(self, ensure_ready=True):
+            if ensure_ready:
+                self.configure(n_jobs=self._n_jobs, parallel=self.parallel)
+
+    return _Backend
+
+
+_registered = False
+
+
+def register_ray_tpu() -> None:
+    """Register the 'ray_tpu' joblib backend (idempotent)."""
+    global _registered
+    if _registered:
+        return
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", _make_backend_class())
+    _registered = True

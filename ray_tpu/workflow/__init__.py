@@ -24,3 +24,9 @@ __all__ = [
     "run",
     "step",
 ]
+
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("workflow")
+del _rlu

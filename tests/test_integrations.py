@@ -1,0 +1,144 @@
+"""Ecosystem integrations: joblib backend, usage stats, pip runtime env
+(model: reference python/ray/tests/test_joblib.py, test_usage_stats.py,
+test_runtime_env_conda_and_pip.py)."""
+import json
+import os
+
+import pytest
+
+
+def test_joblib_backend_parallel(ray_start):
+    import joblib
+    from joblib import Parallel, delayed
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = Parallel()(delayed(lambda x: x * x)(i) for i in range(20))
+    assert out == [i * i for i in range(20)]
+
+
+def test_joblib_backend_callback_accounting(ray_start):
+    """verbose path exercises batch_completed callbacks through the
+    waiter-thread retrieval."""
+    import joblib
+    from joblib import Parallel, delayed
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = Parallel(batch_size=5)(
+            delayed(lambda x: x + 1)(i) for i in range(10)
+        )
+    assert out == list(range(1, 11))
+
+
+def test_usage_stats_disabled_by_default():
+    from ray_tpu._private import usage_stats
+
+    assert not usage_stats.usage_stats_enabled()
+    # recording is a no-op when disabled
+    usage_stats.record_library_usage("data")
+    assert usage_stats.write_report("/tmp") is None
+
+
+def test_usage_stats_report_local_only(monkeypatch, tmp_path):
+    from ray_tpu._private import usage_stats
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.reset_for_tests()
+    usage_stats.record_library_usage("data")
+    usage_stats.record_library_usage("tune")
+    usage_stats.record_extra_usage_tag("test_tag", "1")
+    path = usage_stats.write_report(str(tmp_path))
+    assert path is not None
+    report = json.load(open(path))
+    assert report["libraries_used"] == ["data", "tune"]
+    assert report["extra_usage_tags"] == {"test_tag": "1"}
+    assert report["schema_version"]
+    assert "ray_tpu_version" in report
+    usage_stats.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# pip runtime env (offline: installs a local package with --no-index)
+# ---------------------------------------------------------------------------
+
+
+def _make_local_pkg(root, name="rt_probe_pkg", version="1.0", value=41):
+    pkg = os.path.join(root, name)
+    os.makedirs(os.path.join(pkg, name), exist_ok=True)
+    with open(os.path.join(pkg, "setup.py"), "w") as f:
+        f.write(
+            "from setuptools import setup, find_packages\n"
+            f"setup(name={name!r}, version={version!r}, "
+            "packages=find_packages())\n"
+        )
+    with open(os.path.join(pkg, name, "__init__.py"), "w") as f:
+        f.write(f"VALUE = {value}\n")
+    return pkg
+
+
+def test_pip_runtime_env_creates_venv(tmp_path, monkeypatch):
+    import sys
+
+    from ray_tpu._private.runtime_env import (
+        applied_runtime_env,
+        ensure_pip_env,
+        validate_runtime_env,
+    )
+
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_DIR", str(tmp_path / "envs"))
+    pkg = _make_local_pkg(str(tmp_path), value=41)
+    spec = {
+        "packages": [pkg],
+        "pip_install_options": ["--no-index", "--no-build-isolation"],
+    }
+    validate_runtime_env({"pip": spec})
+    site = ensure_pip_env(spec)
+    assert os.path.isdir(site)
+    assert os.path.isdir(os.path.join(site, "rt_probe_pkg"))
+    # second call hits the .ready cache (fast path, same dir)
+    assert ensure_pip_env(spec) == site
+    # applying the env makes the package importable; leaving restores path
+    with applied_runtime_env({"pip": spec}):
+        import rt_probe_pkg
+
+        assert rt_probe_pkg.VALUE == 41
+    sys.modules.pop("rt_probe_pkg", None)
+    assert site not in sys.path
+
+
+def test_pip_runtime_env_task(ray_start, tmp_path, monkeypatch):
+    """A task with a pip runtime_env imports the freshly installed package
+    inside the worker."""
+    import ray_tpu
+
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_DIR", str(tmp_path / "envs"))
+    pkg = _make_local_pkg(str(tmp_path), name="rt_task_pkg", value=7)
+    env_dir = str(tmp_path / "envs")
+
+    @ray_tpu.remote
+    def probe():
+        import rt_task_pkg
+
+        return rt_task_pkg.VALUE
+
+    ref = probe.options(runtime_env={
+        "env_vars": {"RAY_TPU_RUNTIME_ENV_DIR": env_dir},
+        "pip": {"packages": [pkg],
+                "pip_install_options": ["--no-index",
+                                        "--no-build-isolation"]},
+    }).remote()
+    assert ray_tpu.get(ref, timeout=120) == 7
+
+
+def test_pip_runtime_env_validation():
+    from ray_tpu._private.runtime_env import validate_runtime_env
+
+    with pytest.raises(ValueError):
+        validate_runtime_env({"pip": {"nope": []}})
+    with pytest.raises(ValueError):
+        validate_runtime_env({"pip": "requests"})
