@@ -25,6 +25,8 @@ from typing import Any, Callable
 
 import msgpack
 
+from ray_tpu._private import event_stats
+
 REQUEST, RESPONSE, NOTIFY = 0, 1, 2
 
 
@@ -107,6 +109,16 @@ class RpcServer:
 
     def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0):
         self.service = service
+        # strict wire-schema validation (schema.py): services declare their
+        # schema table via a `schema_service` class attribute
+        self._schema_service = getattr(service, "schema_service", None)
+        from ray_tpu._private import schema as _schema
+
+        self._strict = _schema.strict_mode()
+        # handler-latency accounting (event_stats.py; the reference's
+        # instrumented_io_context records every asio handler the same way)
+        self._stats_name = (self._schema_service
+                            or type(service).__name__.lower())
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if port == 0:
@@ -160,12 +172,33 @@ class RpcServer:
                 mtype, msgid, method, payload = msg
                 if mtype != REQUEST:
                     continue
+                if method == "_handshake":
+                    # version negotiation, answered by the RPC layer itself
+                    # (schema.py; the analog of proto compatibility checks)
+                    from ray_tpu._private import schema
+
+                    try:
+                        conn.send([RESPONSE, msgid, True,
+                                   schema.check_handshake(payload)])
+                    except schema.SchemaError as e:
+                        conn.send([RESPONSE, msgid, False, str(e)])
+                    continue
                 handler = getattr(self.service, "rpc_" + method, None)
                 if handler is None:
                     conn.send([RESPONSE, msgid, False, f"no such method: {method}"])
                     continue
                 try:
+                    if self._schema_service is not None and self._strict:
+                        from ray_tpu._private import schema
+
+                        schema.validate_request(
+                            self._schema_service, method, payload)
+                    t0 = time.perf_counter()
                     result = handler(conn, msgid, payload)
+                    event_stats.record(
+                        f"rpc.{self._stats_name}.{method}",
+                        time.perf_counter() - t0,
+                    )
                     if result is not RpcServer.DEFERRED:
                         conn.send([RESPONSE, msgid, True, result])
                 except Exception:
@@ -213,6 +246,7 @@ class RpcClient:
         connect_timeout: float = 10.0,
         auto_reconnect: bool = False,
         reconnect_window: float = 10.0,
+        handshake: bool = True,
     ):
         host, port = address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)), timeout=connect_timeout)
@@ -234,6 +268,20 @@ class RpcClient:
             name=f"rpc-client-{address}",
         )
         self._reader.start()
+        if handshake:
+            # enforce protocol compatibility before the first real call
+            # (schema.py PROTOCOL_VERSION; mismatch fails the connect)
+            from ray_tpu._private import schema
+
+            try:
+                self.call_async("_handshake", schema.handshake_payload()) \
+                    .result(connect_timeout)
+            except BaseException as e:
+                # any failure mode (mismatch, timeout, peer drop) must tear
+                # the client down — a leaked socket + reader thread per
+                # retry otherwise accumulates in reconnect loops
+                self.close()
+                raise RpcError(f"handshake with {address} failed: {e}") from e
 
     def _read_loop(self, sock: socket.socket, gen: int) -> None:
         while not self._closed.is_set():
@@ -351,7 +399,22 @@ class RpcClient:
                 name=f"rpc-client-{self.address}",
             )
             self._reader.start()
-            return True
+        # re-run the protocol check: a restart-in-place may have come back
+        # as an upgraded binary. A version mismatch raises (permanent);
+        # transient handshake failures report the connection as not healed.
+        from ray_tpu._private import schema
+
+        try:
+            self.call_async("_handshake", schema.handshake_payload()) \
+                .result(self._connect_timeout)
+        except RpcError as e:
+            self.close()
+            raise RpcError(
+                f"handshake with {self.address} failed after reconnect: {e}"
+            ) from e
+        except BaseException:
+            return False
+        return True
 
     def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
         try:

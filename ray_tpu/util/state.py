@@ -136,3 +136,34 @@ def summary() -> dict:
             "total": len(list_actors()),
         },
     }
+
+
+def event_stats() -> dict[str, dict]:
+    """Per-process control-loop latency stats (reference: the event_stats
+    section of `ray debug_state.txt`, src/ray/common/asio/
+    instrumented_io_context.h). Process-local: covers this driver's RPC
+    servers and raylet loops when they run in-process."""
+    from ray_tpu._private import event_stats as es
+
+    return es.snapshot()
+
+
+def debug_state() -> str:
+    """Human-readable debug dump (the reference's debug_state.txt)."""
+    from ray_tpu._private import event_stats as es
+
+    lines = ["== event_stats ==", es.summary_string()]
+    try:
+        nodes = list_nodes()
+        lines.append("== nodes ==")
+        for n in nodes:
+            nid = n["node_id"]
+            nid = nid.hex() if isinstance(nid, bytes) else str(nid)
+            lines.append(
+                f"{nid[:12]} alive={n.get('alive')} "
+                f"disk={n.get('disk_used_frac', float('nan')):.2f} "
+                f"load={n.get('load', 0)}"
+            )
+    except Exception:  # noqa: BLE001 — dump what we can without a cluster
+        pass
+    return "\n".join(lines)

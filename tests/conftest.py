@@ -12,6 +12,9 @@ import os
 
 # Must be set before the first jax backend initialization.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Strict wire-schema validation (schema.py): GCS rejects malformed payloads
+# in tests so message drift fails loudly at the RPC boundary.
+os.environ.setdefault("RAY_TPU_STRICT_SCHEMA", "1")
 
 import pytest
 

@@ -1,0 +1,83 @@
+"""Wire-schema layer: version handshake + strict payload validation
+(model: reference proto compatibility — src/ray/protobuf/*.proto is the
+single source of message truth; here that role is _private/schema.py)."""
+import pytest
+
+from ray_tpu._private import schema
+from ray_tpu._private.rpc import RpcClient, RpcError, RpcServer
+
+
+class _EchoService:
+    schema_service = "gcs"
+
+    def rpc_kv_get(self, conn, msgid, p):
+        return {"value": p["key"]}
+
+    def rpc_unschema(self, conn, msgid, p):
+        return {"echo": p}
+
+
+def test_handshake_accepts_matching_protocol():
+    srv = RpcServer(_EchoService())
+    try:
+        c = RpcClient(srv.address)  # handshake on by default
+        assert c.call("kv_get", {"key": b"x"})["value"] == b"x"
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_handshake_rejects_version_mismatch(monkeypatch):
+    srv = RpcServer(_EchoService())
+    try:
+        # client speaks a future protocol; the server must refuse it
+        monkeypatch.setattr(
+            schema, "handshake_payload",
+            lambda: {"protocol": 99, "version": "test"},
+        )
+        with pytest.raises(RpcError, match="protocol version mismatch"):
+            RpcClient(srv.address)
+    finally:
+        srv.stop()
+
+
+def test_strict_mode_rejects_bad_payloads(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_STRICT_SCHEMA", "1")
+    srv = RpcServer(_EchoService())
+    try:
+        c = RpcClient(srv.address)
+        # missing required field
+        with pytest.raises(RpcError, match="missing fields"):
+            c.call("kv_get", {})
+        # unknown field
+        with pytest.raises(RpcError, match="unknown fields"):
+            c.call("kv_get", {"key": b"x", "bogus": 1})
+        # methods outside the schema table pass through opaque
+        assert c.call("unschema", {"anything": 1}) == {"echo": {"anything": 1}}
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_schema_table_matches_gcs_handlers():
+    """Every schema entry corresponds to a real GCS handler, and every
+    GCS handler has a schema entry — the table cannot drift silently."""
+    from ray_tpu._private.gcs import GcsService
+
+    handlers = {n[len("rpc_"):] for n in dir(GcsService)
+                if n.startswith("rpc_")}
+    declared = set(schema.SCHEMAS["gcs"])
+    assert declared <= handlers, f"schema for ghosts: {declared - handlers}"
+    missing = handlers - declared
+    assert not missing, f"handlers without schema: {missing}"
+
+
+def test_validate_request_shapes():
+    schema.validate_request("gcs", "kv_put", {"key": b"k", "value": b"v"})
+    with pytest.raises(schema.SchemaError):
+        schema.validate_request("gcs", "kv_put", {"key": b"k"})
+    with pytest.raises(schema.SchemaError):
+        schema.validate_request("gcs", "kv_put", [1, 2])
+    # unknown service/method: opaque, no error
+    schema.validate_request("nope", "x", {"a": 1})
+    schema.validate_request("gcs", "not_a_method", {"a": 1})
