@@ -59,6 +59,19 @@ def init(
             address = _os.environ.get("RT_ADDRESS", "")
             if not address:
                 raise RuntimeError('init(address="auto") needs RT_ADDRESS set')
+        if address.startswith("ray://"):
+            # out-of-cluster driver: proxy the whole API through the head's
+            # client server (util/client.py; reference: ray client,
+            # python/ray/util/client/)
+            if is_initialized():
+                if ignore_reinit_error:
+                    return None
+                raise RuntimeError(
+                    "ray_tpu.init() called twice; pass ignore_reinit_error=True")
+            from ray_tpu.util.client import connect_client
+
+            connect_client(address)
+            return None
         if is_initialized():
             if ignore_reinit_error:
                 return None
@@ -119,6 +132,16 @@ def init(
             node_id=_node_handle.node_id,
         )
         set_global_worker(core)
+        # ray:// client server (ephemeral port unless pinned via env;
+        # the CLI `start --head` pins the reference's canonical 10001)
+        try:
+            from ray_tpu.util.client import ClientServer
+
+            port = int(_os.environ.get("RAY_TPU_CLIENT_SERVER_PORT", "0"))
+            _node_handle.client_server = ClientServer(
+                _node_handle, host="127.0.0.1", port=port)
+        except Exception:  # noqa: BLE001 — client server is auxiliary
+            _node_handle.client_server = None
         return _node_handle
 
 
@@ -152,11 +175,21 @@ def connect(
 def shutdown() -> None:
     global _node_handle
     with _init_lock:
+        if _node_handle is not None:
+            # opt-in usage report lands in the session dir before teardown
+            # (local file only — see _private/usage_stats.py)
+            from ray_tpu._private import usage_stats
+
+            usage_stats.write_report(
+                getattr(_node_handle, "session_dir", None))
         w = _worker_mod.global_worker_or_none()
         if w is not None:
             w.shutdown()
             set_global_worker(None)
         if _node_handle is not None:
+            cs = getattr(_node_handle, "client_server", None)
+            if cs is not None:
+                cs.stop()
             _node_handle.shutdown()
             _node_handle = None
 

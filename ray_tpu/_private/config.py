@@ -23,6 +23,10 @@ class Config:
     # --- object store ---
     object_store_memory_bytes: int = 512 * 1024 * 1024
     object_spilling_dir: str = ""  # default: <store socket>.spill
+    # per-pass spill batch floor: under pressure the store spills LRU
+    # objects until at least this many bytes moved, amortizing disk IO
+    # (reference: min_spilling_size, local_object_manager.cc)
+    min_spilling_size: int = 8 * 1024 * 1024
     object_pull_chunk_bytes: int = 8 * 1024 * 1024  # inter-node transfer chunk
     # --- raylet ---
     num_workers_soft_limit: int = -1  # default: num_cpus
@@ -39,6 +43,10 @@ class Config:
     # and newest first. 0 disables the monitor.
     memory_usage_threshold: float = 0.95
     memory_monitor_refresh_ms: int = 250
+    # above this disk-used fraction on the session filesystem the raylet
+    # stops starting new tasks (reference: local_fs_capacity_threshold,
+    # file_system_monitor.h). 0 disables the check.
+    local_fs_capacity_threshold: float = 0.98
     # --- GCS ---
     gcs_heartbeat_interval_ms: int = 1000
     health_check_failure_threshold: int = 5

@@ -28,6 +28,9 @@ from ray_tpu._private.rpc import RpcClient, RpcServer
 
 
 class GcsService:
+    # strict-mode wire validation against schema.SCHEMAS["gcs"] (rpc.py)
+    schema_service = "gcs"
+
     def __init__(self, store=None):
         """store: a StoreClient (store_client.py). File-backed stores give
         head-restart tolerance — the reference's Redis-backed GCS mode
@@ -42,6 +45,11 @@ class GcsService:
         self._kv: dict[str, dict[bytes, bytes]] = defaultdict(dict)
         # node_id(bytes) -> {address, resources, labels, alive, last_heartbeat}
         self.nodes: dict[bytes, dict] = {}
+        # delta-sync state: monotonically versioned node-table mutations
+        # (reference: ray_syncer.h:86 version-stamped delta gossip)
+        self._node_seq = 0
+        self._node_tombstones: list[tuple[int, bytes]] = []
+        self._tombstone_floor = 0  # removals below this seq were trimmed
         # actor_id(bytes) -> {state, class_name, node_id, raylet_address,
         #                     num_restarts, max_restarts, spec}
         self.actors: dict[bytes, dict] = {}
@@ -198,6 +206,12 @@ class GcsService:
         GcsActorManager::RestartActor)."""
         self._publish("node_death", {"node_id": node_id})
         with self._lock:
+            self._node_seq += 1
+            self._node_tombstones.append((self._node_seq, node_id))
+            if len(self._node_tombstones) > 1000:
+                # clients older than the trimmed horizon get a full resync
+                self._tombstone_floor = self._node_tombstones[-1000][0]
+                del self._node_tombstones[:-1000]
             affected = [
                 aid for aid, a in self.actors.items() if a.get("node_id") == node_id
             ]
@@ -234,9 +248,32 @@ class GcsService:
 
     # ---------------- RPC: nodes ----------------
 
+    def _bump_node_seq_locked(self, info: dict) -> None:
+        """Version-stamp a node-table mutation for the delta syncer
+        (reference: ray_syncer.h:86 — components exchange version-stamped
+        deltas, not full snapshots)."""
+        self._node_seq += 1
+        info["_seq"] = self._node_seq
+
+    def _node_view_locked(self, nid: bytes, n: dict) -> dict:
+        view = {
+            "node_id": nid,
+            "address": n["address"],
+            "resources": n["resources"],
+            "labels": n["labels"],
+            "alive": n["alive"],
+            "available": n.get("available", n["resources"]),
+            "load": n.get("load", 0),
+            "pending_shapes": n.get("pending_shapes", []),
+            "store_socket": n.get("store_socket", ""),
+        }
+        if "disk_used_frac" in n:
+            view["disk_used_frac"] = n["disk_used_frac"]
+        return view
+
     def rpc_register_node(self, conn, msgid, p):
         with self._lock:
-            self.nodes[p["node_id"]] = {
+            self.nodes[p["node_id"]] = info = {
                 "address": p["address"],
                 "resources": p["resources"],
                 "labels": p.get("labels", {}),
@@ -244,25 +281,49 @@ class GcsService:
                 "alive": True,
                 "last_heartbeat": time.monotonic(),
             }
+            self._bump_node_seq_locked(info)
         self._publish("node_added", {"node_id": p["node_id"], "address": p["address"]})
         return {"ok": True}
 
     def rpc_heartbeat(self, conn, msgid, p):
         """Periodic resource report — the RaySyncer-gossip analog
-        (reference: src/ray/common/ray_syncer/ray_syncer.h:86)."""
+        (reference: src/ray/common/ray_syncer/ray_syncer.h:86). With a
+        `seen_seq`, the reply carries the DELTA of the node table since
+        that version (changed node views + removed ids) instead of the
+        raylet re-pulling the full table every tick."""
         with self._lock:
             info = self.nodes.get(p["node_id"])
             if info is None:
                 return {"ok": False, "reregister": True}
             info["last_heartbeat"] = time.monotonic()
             info["alive"] = True
-            if "available" in p:
-                info["available"] = p["available"]
-            if "load" in p:
-                info["load"] = p["load"]
-            if "pending_shapes" in p:
-                info["pending_shapes"] = p["pending_shapes"]
-        return {"ok": True}
+            # bump the sync version ONLY when a reported value actually
+            # changed — otherwise every heartbeat would invalidate every
+            # peer's delta and each tick would degenerate to a full table
+            changed = False
+            for k in ("available", "load", "pending_shapes", "disk_used_frac"):
+                if k in p and info.get(k) != p[k]:
+                    info[k] = p[k]
+                    changed = True
+            if changed:
+                self._bump_node_seq_locked(info)
+            reply = {"ok": True}
+            if "seen_seq" in p:
+                seen = p["seen_seq"]
+                reply["seq"] = self._node_seq
+                if seen < self._tombstone_floor:
+                    # removal history trimmed past this client: full resync
+                    seen = 0
+                    reply["full"] = True
+                reply["delta"] = [
+                    self._node_view_locked(nid, n)
+                    for nid, n in self.nodes.items()
+                    if n.get("_seq", 0) > seen and n["alive"]
+                ]
+                reply["removed"] = [
+                    nid for seq, nid in self._node_tombstones if seq > seen
+                ]
+        return reply
 
     def rpc_drain_node(self, conn, msgid, p):
         with self._lock:
@@ -276,17 +337,7 @@ class GcsService:
         with self._lock:
             return {
                 "nodes": [
-                    {
-                        "node_id": nid,
-                        "address": n["address"],
-                        "resources": n["resources"],
-                        "labels": n["labels"],
-                        "alive": n["alive"],
-                        "available": n.get("available", n["resources"]),
-                        "load": n.get("load", 0),
-                        "pending_shapes": n.get("pending_shapes", []),
-                        "store_socket": n.get("store_socket", ""),
-                    }
+                    self._node_view_locked(nid, n)
                     for nid, n in self.nodes.items()
                 ]
             }

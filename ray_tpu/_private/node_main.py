@@ -36,6 +36,9 @@ def main(argv=None) -> None:
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--client-server-port", type=int, default=None,
+                   help="ray:// client server port (head only; default "
+                        "10001, 0 = ephemeral, -1 = disabled)")
     p.add_argument("--resources", default=None, help='JSON dict, e.g. \'{"A":1}\'')
     p.add_argument("--labels", default=None, help="JSON dict of node labels")
     p.add_argument("--info-file", default=None)
@@ -59,6 +62,24 @@ def main(argv=None) -> None:
     else:
         handle = start_worker_node(args.address, **common)
 
+    client_address = None
+    if args.head and (args.client_server_port is None
+                      or args.client_server_port >= 0):
+        # ray:// proxy for out-of-cluster drivers (util/client.py;
+        # reference: ray start --head opens the client server on 10001)
+        from ray_tpu.util.client import DEFAULT_CLIENT_PORT, ClientServer
+
+        port = (DEFAULT_CLIENT_PORT if args.client_server_port is None
+                else args.client_server_port)
+        try:
+            handle.client_server = ClientServer(handle, port=port)
+            client_address = handle.client_server.address
+        except OSError:
+            # canonical port taken (another head on this host): fall back
+            # to an ephemeral port rather than failing the node
+            handle.client_server = ClientServer(handle, port=0)
+            client_address = handle.client_server.address
+
     info = {
         "pid": os.getpid(),
         "gcs_address": handle.gcs_address,
@@ -67,6 +88,7 @@ def main(argv=None) -> None:
         "node_id": handle.node_id.hex(),
         "session_dir": handle.session_dir,
         "head": bool(args.head),
+        "client_address": client_address,
     }
     info_path = args.info_file or default_info_path()
     os.makedirs(os.path.dirname(info_path), exist_ok=True)
@@ -80,6 +102,9 @@ def main(argv=None) -> None:
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    cs = getattr(handle, "client_server", None)
+    if cs is not None:
+        cs.stop()
     handle.shutdown()
     try:
         os.remove(info_path)

@@ -81,18 +81,25 @@ def _gc_stale_segments() -> None:
 
 
 def start_store(
-    socket_path: str, capacity_bytes: int, spill_dir: str | None = None
+    socket_path: str, capacity_bytes: int, spill_dir: str | None = None,
+    min_spilling_size: int | None = None,
 ) -> subprocess.Popen:
     """Launch the daemon and wait for its READY handshake. spill_dir
     defaults to <socket>.spill next to the socket; pass "" to disable
-    spilling (pressure then fails creates instead)."""
+    spilling (pressure then fails creates instead). min_spilling_size is
+    the per-pass spill batch floor (config.min_spilling_size)."""
+    from ray_tpu._private.config import global_config
+
     binary = build_store_binary()
     _gc_stale_segments()
     if spill_dir is None:
         spill_dir = socket_path + ".spill"
+    if min_spilling_size is None:
+        min_spilling_size = global_config().min_spilling_size
     argv = [binary, socket_path, str(capacity_bytes)]
     if spill_dir:
         argv.append(spill_dir)
+        argv.append(str(min_spilling_size))
     proc = subprocess.Popen(
         argv,
         stdout=subprocess.PIPE,
@@ -148,6 +155,29 @@ class ObjectStoreClient:
         # created-but-not-sealed mappings, promoted to _mappings on seal()
         self._pending_creates: dict[bytes, _Mapping] = {}
         self._map_lock = threading.Lock()
+        # pooled secondary connections for blocking OP_WAITs
+        self._wait_socks: list[socket.socket] = []
+        self._wait_lock = threading.Lock()
+
+    _MAX_WAIT_SOCKS = 8
+
+    def _checkout_wait_sock(self) -> socket.socket:
+        with self._wait_lock:
+            if self._wait_socks:
+                return self._wait_socks.pop()
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(self._socket_path)
+        return s
+
+    def _checkin_wait_sock(self, s: socket.socket) -> None:
+        with self._wait_lock:
+            if len(self._wait_socks) < self._MAX_WAIT_SOCKS:
+                self._wait_socks.append(s)
+                return
+        try:
+            s.close()
+        except OSError:
+            pass
 
     def _request(self, op: int, object_id: bytes, payload: bytes = b"") -> tuple[int, bytes]:
         msg = struct.pack("<IB", 1 + len(object_id) + len(payload), op) + object_id + payload
@@ -309,20 +339,26 @@ class ObjectStoreClient:
         """BLOCK in the daemon until >= num_returns of object_ids are
         present (or timeout); returns the present subset. Replaces
         client-side contains() busy-polling — the daemon's seal cv wakes
-        waiters the moment an object lands. Runs on its own ephemeral
-        connection so it never stalls this client's request socket."""
+        waiters the moment an object lands. Runs on a CACHED secondary
+        connection (one per concurrently-blocked waiter, pooled and
+        reused) so it never stalls this client's request socket and
+        looping waiters don't churn daemon threads."""
         ids = [o.binary() for o in object_ids]
         payload = struct.pack("<QII", timeout_ms, num_returns, len(ids)) + b"".join(ids)
         msg = struct.pack("<IB", 1 + 28 + len(payload), OP_WAIT) + b"\x00" * 28 + payload
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock = self._checkout_wait_sock()
         try:
-            sock.connect(self._socket_path)
             sock.sendall(msg)
             header = _recv_exact(sock, 4)
             (length,) = struct.unpack("<I", header)
             body = _recv_exact(sock, length)
-        finally:
-            sock.close()
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        self._checkin_wait_sock(sock)
         if body[0] != ST_OK:
             raise RuntimeError(f"wait failed: status {body[0]}")
         (m,) = struct.unpack_from("<I", body, 1)
@@ -375,6 +411,13 @@ class ObjectStoreClient:
             self._mappings.clear()
         for m in mappings:
             m.close()
+        with self._wait_lock:
+            socks, self._wait_socks = self._wait_socks, []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
         self._sock.close()
 
     @staticmethod

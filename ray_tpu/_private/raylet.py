@@ -97,7 +97,18 @@ class Raylet:
         self._peer_clients: dict[str, RpcClient] = {}
         self._actor_seq = 0  # tie-breaker for the per-actor method heap
         self._cluster_view: dict[bytes, dict] = {}
+        self._cluster_seq = 0  # highest node-table version applied (delta sync)
         self._stopped = threading.Event()
+        # disk-full protection: when the session filesystem crosses the
+        # threshold the dispatch loop stops STARTING work (queued tasks
+        # wait; running ones finish) — reference file_system_monitor.h
+        from ray_tpu._private.file_system_monitor import FileSystemMonitor
+
+        self._fs_monitor = FileSystemMonitor(
+            [os.path.dirname(store_socket) if store_socket else ""],
+            cfg.local_fs_capacity_threshold,
+            cache_ttl_s=0.25,  # dispatch runs per task wakeup: amortize
+        )
         # inter-node object plane state
         self._fetching: set[bytes] = set()  # pulls in flight
         self._dep_fetch_ts: dict[bytes, float] = {}  # dep oid -> last fetch req
@@ -171,15 +182,19 @@ class Raylet:
                     # bin-packs these onto node types (reference:
                     # resource_demand_scheduler.py:102 get_nodes_to_launch)
                     shapes = [dict(s["resources"]) for s in self._queued[:100]]
-                reply = self.gcs.call(
-                    "heartbeat",
-                    {
-                        "node_id": self.node_id.binary(),
-                        "available": avail,
-                        "load": load,
-                        "pending_shapes": shapes,
-                    },
-                )
+                hb = {
+                    "node_id": self.node_id.binary(),
+                    "available": avail,
+                    "load": load,
+                    "pending_shapes": shapes,
+                    # delta sync: ask only for node-table changes since the
+                    # last tick (reference: ray_syncer.h versioned deltas)
+                    "seen_seq": self._cluster_seq,
+                }
+                disk = self._fs_monitor.usage_fraction()
+                if disk is not None:
+                    hb["disk_used_frac"] = disk
+                reply = self.gcs.call("heartbeat", hb)
                 if reply.get("reregister"):
                     # the GCS restarted and lost the node table — re-announce
                     # (reference: node_manager.cc:1168 HandleNotifyGCSRestart)
@@ -196,11 +211,22 @@ class Raylet:
                     # ...and its store contents: the object directory is
                     # in-memory GCS state and died with the old incarnation
                     self._republish_store_contents()
-                nodes = self.gcs.call("get_nodes")["nodes"]
+                    with self._lock:
+                        # the new GCS incarnation restarts its version
+                        # counter — drop the stale view entirely and resync
+                        # from zero (nodes that died during the outage have
+                        # no tombstone in the new incarnation)
+                        self._cluster_seq = 0
+                        self._cluster_view = {}
                 with self._lock:
-                    self._cluster_view = {
-                        n["node_id"]: n for n in nodes if n["alive"]
-                    }
+                    if reply.get("full"):
+                        self._cluster_view = {}
+                    for n in reply.get("delta", ()):
+                        self._cluster_view[n["node_id"]] = n
+                    for nid in reply.get("removed", ()):
+                        self._cluster_view.pop(nid, None)
+                    if "seq" in reply:
+                        self._cluster_seq = reply["seq"]
             except Exception:
                 if self._stopped.is_set():
                     return
@@ -803,14 +829,21 @@ class Raylet:
     # ------------- dispatch -------------
 
     def _dispatch_loop(self) -> None:
+        from ray_tpu._private import event_stats
+
         while not self._stopped.is_set():
             with self._dispatch_cv:
                 self._dispatch_cv.wait(timeout=0.05)
                 if self._stopped.is_set():
                     return
-            self._dispatch_once()
+            with event_stats.timed("raylet.dispatch"):
+                self._dispatch_once()
 
     def _dispatch_once(self) -> None:
+        if self._fs_monitor.over_capacity():
+            # out-of-disk node: hold queued work (running tasks finish);
+            # reference raylet likewise stops granting leases over capacity
+            return
         while True:
             dispatched = False
             with self._lock:

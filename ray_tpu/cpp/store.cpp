@@ -49,6 +49,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -90,8 +91,9 @@ struct ObjectEntry {
 
 class Store {
  public:
-  Store(uint64_t capacity, std::string spill_dir)
-      : capacity_(capacity), spill_dir_(std::move(spill_dir)) {}
+  Store(uint64_t capacity, std::string spill_dir, uint64_t min_spill)
+      : capacity_(capacity), spill_dir_(std::move(spill_dir)),
+        min_spill_(min_spill) {}
 
   uint8_t Create(const std::string &id, uint64_t size, std::string *shm_name) {
     std::unique_lock<std::mutex> lk(mu_);
@@ -367,17 +369,30 @@ class Store {
         continue;
       }
       if (spill_dir_.empty()) return false;
-      // no evictable object: spill the LRU referenced in-memory object
-      best_tick = UINT64_MAX;
+      // no evictable object: spill referenced in-memory objects, LRU
+      // first, as a BATCH of at least min_spill_ bytes per pass so disk
+      // IO is amortized (reference: local_object_manager.cc spills in
+      // >= min_spilling_size batches)
+      std::vector<std::pair<uint64_t, std::string>> order;
       for (auto &kv : objects_) {
-        if (kv.second.sealed && !kv.second.spilled && kv.second.size > 0 &&
-            kv.second.lru_tick < best_tick) {
-          best_tick = kv.second.lru_tick;
-          victim = kv.first;
+        if (kv.second.sealed && !kv.second.spilled && kv.second.size > 0)
+          order.emplace_back(kv.second.lru_tick, kv.first);
+      }
+      if (order.empty()) return false;
+      std::sort(order.begin(), order.end());
+      uint64_t want = needed > min_spill_ ? needed : min_spill_;
+      uint64_t freed = 0;
+      bool any = false;
+      for (auto &tick_id : order) {
+        if (freed >= want) break;
+        ObjectEntry &e = objects_[tick_id.second];
+        uint64_t sz = e.size;
+        if (SpillLocked(tick_id.second, e)) {
+          freed += sz;
+          any = true;
         }
       }
-      if (victim.empty()) return false;
-      if (!SpillLocked(victim, objects_[victim])) return false;
+      if (!any) return false;
     }
     return true;
   }
@@ -468,6 +483,8 @@ class Store {
   std::unordered_set<std::string> tombstones_;
   uint64_t capacity_;
   std::string spill_dir_;
+  uint64_t min_spill_ = 0;  // batch floor per spill pass (config
+                            // min_spilling_size)
   uint64_t used_ = 0;
   uint64_t tick_ = 0;
   uint64_t seq_ = 0;
@@ -673,13 +690,16 @@ void HandleTerm(int) {
 
 int main(int argc, char **argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: %s <socket_path> <capacity_bytes> [spill_dir]\n",
+    fprintf(stderr,
+            "usage: %s <socket_path> <capacity_bytes> [spill_dir] "
+            "[min_spill_bytes]\n",
             argv[0]);
     return 1;
   }
   const char *sock_path = argv[1];
   uint64_t capacity = strtoull(argv[2], nullptr, 10);
   std::string spill_dir = argc > 3 ? argv[3] : "";
+  uint64_t min_spill = argc > 4 ? strtoull(argv[4], nullptr, 10) : 0;
   if (!spill_dir.empty() && mkdir(spill_dir.c_str(), 0700) != 0 &&
       errno != EEXIST) {
     fprintf(stderr, "cannot create spill dir %s\n", spill_dir.c_str());
@@ -695,7 +715,7 @@ int main(int argc, char **argv) {
       spill_dir.clear();
     }
   }
-  Store store(capacity, spill_dir);
+  Store store(capacity, spill_dir, min_spill);
   g_store = &store;
   g_sock_path = sock_path;
   signal(SIGTERM, HandleTerm);

@@ -52,6 +52,8 @@ def cmd_start(args) -> None:
         node_argv += ["--num-tpus", str(args.num_tpus)]
     if args.object_store_memory is not None:
         node_argv += ["--object-store-memory", str(args.object_store_memory)]
+    if args.client_server_port is not None:
+        node_argv += ["--client-server-port", str(args.client_server_port)]
     if args.resources:
         node_argv += ["--resources", args.resources]
     if args.info_file:
@@ -73,6 +75,9 @@ def cmd_start(args) -> None:
     if args.head:
         print(f"to join:    ray_tpu start --address {info['gcs_address']}")
         print(f"to connect: ray_tpu.init(address=\"{info['gcs_address']}\")")
+        if info.get("client_address"):
+            print("remote drivers: ray_tpu.init(address="
+                  f"\"ray://{info['client_address']}\")")
 
 
 def cmd_stop(args) -> None:
@@ -178,6 +183,8 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--num-cpus", type=float, default=None)
     st.add_argument("--num-tpus", type=float, default=None)
     st.add_argument("--object-store-memory", type=int, default=None)
+    st.add_argument("--client-server-port", type=int, default=None,
+                    help="ray:// port (head; default 10001, -1 disables)")
     st.add_argument("--resources", default=None, help="JSON dict")
     st.add_argument("--info-file", default=None)
     st.add_argument("--block", action="store_true", help="run in foreground")

@@ -1,0 +1,348 @@
+"""ray:// client — drive a cluster from OUTSIDE it.
+
+Equivalent of the reference's Ray Client (reference:
+python/ray/util/client/ — `ray.init("ray://host:10001")` proxies the core
+API over gRPC to a server-side proxy that owns real core workers,
+python/ray/util/client/server/proxier.py:49). Same architecture here:
+
+  * ClientServer runs on the head next to the GCS; each client connection
+    gets its own server-side CoreWorker (its own job), which OWNS every
+    object/actor the client creates — ownership, ref-counting, and lineage
+    stay inside the cluster, exactly like the reference's proxied workers.
+  * ClientWorker implements the CoreWorker surface the API layer uses
+    (put/get/wait/submit_task/submit_actor_task/gcs.call/...) by
+    forwarding over one msgpack RPC connection, so `@remote` functions,
+    actors, and the state API work unchanged from an out-of-cluster
+    process: ray_tpu.init(address="ray://host:port").
+
+Values cross the wire as this framework's own serialization blobs
+(cloudpickle + oob buffers), produced/consumed at each end.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Sequence
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private import task_spec as ts
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.rpc import RpcClient, RpcServer
+
+DEFAULT_CLIENT_PORT = 10001
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+
+class _ClientSession:
+    """One connected client = one dedicated server-side CoreWorker."""
+
+    def __init__(self, node_handle):
+        from ray_tpu._private.worker import CoreWorker
+
+        gcs = node_handle.raylet.gcs
+        job_id = JobID(gcs.call("next_job_id")["job_id"])
+        self.worker = CoreWorker(
+            mode="driver",
+            gcs_address=node_handle.gcs_address,
+            raylet_address=node_handle.raylet.address,
+            store_socket=node_handle.store_socket,
+            job_id=job_id,
+            node_id=node_handle.node_id,
+        )
+
+    def close(self):
+        try:
+            self.worker.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+
+class ClientService:
+    """RPC service: client_* methods proxied onto per-connection workers
+    (reference: proxier.py routes each client to its SpecificServer)."""
+
+    def __init__(self, node_handle):
+        self._node = node_handle
+        self._lock = threading.Lock()
+
+    def _session(self, conn) -> _ClientSession:
+        s = conn.meta.get("client_session")
+        if s is None:
+            s = _ClientSession(self._node)
+            conn.meta["client_session"] = s
+            conn.on_close.append(
+                lambda c: c.meta["client_session"].close())
+        return s
+
+    # -- core API --
+
+    def rpc_client_init(self, conn, msgid, p):
+        s = self._session(conn)
+        return {"job_id": s.worker.job_id.binary()}
+
+    def rpc_client_put(self, conn, msgid, p):
+        s = self._session(conn)
+        value = ser.loads(p["blob"])
+        ref = s.worker.put(value)
+        return {"oid": ref.binary()}
+
+    def rpc_client_get(self, conn, msgid, p):
+        s = self._session(conn)
+        refs = [ObjectRef(ObjectID(o)) for o in p["oids"]]
+        out = []
+        for r in refs:
+            try:
+                value = s.worker.get(r, timeout=p.get("timeout"))
+                out.append({"blob": ser.dumps(value)})
+            except Exception as e:  # noqa: BLE001 — ships to the client
+                out.append({"error": ser.dumps(e)})
+        return {"results": out}
+
+    def rpc_client_wait(self, conn, msgid, p):
+        s = self._session(conn)
+        refs = [ObjectRef(ObjectID(o)) for o in p["oids"]]
+        ready, not_ready = s.worker.wait(
+            refs, num_returns=p["num_returns"], timeout=p.get("timeout")
+        )
+        return {
+            "ready": [r.binary() for r in ready],
+            "not_ready": [r.binary() for r in not_ready],
+        }
+
+    def rpc_client_submit(self, conn, msgid, p):
+        s = self._session(conn)
+        refs = s.worker.submit_task(p["spec"])
+        return {"oids": [r.binary() for r in refs]}
+
+    def rpc_client_submit_actor(self, conn, msgid, p):
+        s = self._session(conn)
+        refs = s.worker.submit_actor_task(p["spec"], p.get("raylet_address"))
+        return {"oids": [r.binary() for r in refs]}
+
+    def rpc_client_actor_addr(self, conn, msgid, p):
+        s = self._session(conn)
+        addr = s.worker.actor_raylet_address(
+            ActorID(p["actor_id"]), timeout=p.get("timeout", 60)
+        )
+        return {"address": addr}
+
+    def rpc_client_seqno(self, conn, msgid, p):
+        s = self._session(conn)
+        return {"seqno": s.worker.next_actor_seqno(ActorID(p["actor_id"]))}
+
+    def rpc_client_invalidate_actor(self, conn, msgid, p):
+        s = self._session(conn)
+        s.worker.invalidate_actor_cache(ActorID(p["actor_id"]))
+        return {"ok": True}
+
+    def rpc_client_free(self, conn, msgid, p):
+        s = self._session(conn)
+        for o in p["oids"]:
+            try:
+                s.worker.remove_local_ref(o)
+            except Exception:  # noqa: BLE001
+                pass
+        return {"ok": True}
+
+    # -- control-plane passthrough --
+
+    def rpc_client_gcs(self, conn, msgid, p):
+        s = self._session(conn)
+        return {"result": s.worker.gcs.call(p["method"], p.get("payload"))}
+
+    def rpc_client_peer(self, conn, msgid, p):
+        s = self._session(conn)
+        target = p["address"]
+        if target == s.worker.raylet.address:
+            client = s.worker.raylet
+        else:
+            client = s.worker._peer(target)
+        return {"result": client.call(p["method"], p.get("payload"))}
+
+
+class ClientServer:
+    """Listens for ray:// clients (reference: `ray start --head` opens the
+    client server on port 10001)."""
+
+    def __init__(self, node_handle, host: str = "0.0.0.0",
+                 port: int = DEFAULT_CLIENT_PORT):
+        self._server = RpcServer(ClientService(node_handle), host, port)
+        self.address = self._server.address
+
+    def stop(self):
+        self._server.stop()
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+
+class _GcsProxy:
+    def __init__(self, rpc: RpcClient):
+        self._rpc = rpc
+
+    def call(self, method: str, payload: Any = None, timeout=None):
+        return self._rpc.call(
+            "client_gcs", {"method": method, "payload": payload},
+            timeout=timeout,
+        )["result"]
+
+    def call_async(self, method: str, payload: Any = None):
+        return self._rpc.call_async(
+            "client_gcs", {"method": method, "payload": payload})
+
+    def close(self):
+        pass  # the ClientWorker owns the underlying connection
+
+
+class _PeerProxy:
+    def __init__(self, rpc: RpcClient, address: str):
+        self._rpc = rpc
+        self.address = address
+
+    def call(self, method: str, payload: Any = None, timeout=None):
+        return self._rpc.call(
+            "client_peer",
+            {"address": self.address, "method": method, "payload": payload},
+            timeout=timeout,
+        )["result"]
+
+
+class ClientWorker:
+    """CoreWorker-surface shim speaking to a ClientServer. Installed via
+    set_global_worker, so the whole public API routes through it."""
+
+    mode = "client"
+
+    def __init__(self, address: str):
+        self._rpc = RpcClient(address, auto_reconnect=False)
+        self.job_id = JobID(self._rpc.call("client_init")["job_id"])
+        self.gcs = _GcsProxy(self._rpc)
+        # server-side raylet address, for kill()'s peer routing
+        self.raylet = _PeerProxy(self._rpc, "")
+        self._seq_lock = threading.Lock()
+
+    # -- identity helpers the API layer uses --
+
+    def new_task_id(self) -> TaskID:
+        return TaskID.for_task(self.job_id)
+
+    def next_actor_seqno(self, actor_id: ActorID) -> int:
+        return self._rpc.call(
+            "client_seqno", {"actor_id": actor_id.binary()})["seqno"]
+
+    def actor_raylet_address(self, actor_id: ActorID, timeout: float = 60):
+        return self._rpc.call(
+            "client_actor_addr",
+            {"actor_id": actor_id.binary(), "timeout": timeout},
+            timeout=timeout + 10,
+        )["address"]
+
+    def invalidate_actor_cache(self, actor_id: ActorID) -> None:
+        self._rpc.call("client_invalidate_actor",
+                       {"actor_id": actor_id.binary()})
+
+    def _peer(self, address: str) -> _PeerProxy:
+        return _PeerProxy(self._rpc, address)
+
+    # -- ref counting: releases forwarded to the owning server worker --
+
+    def add_local_ref(self, oid: bytes) -> None:
+        pass  # the server-side worker owns the ref bookkeeping
+
+    def remove_local_ref(self, oid: bytes) -> None:
+        try:
+            self._rpc.call_async("client_free", {"oids": [oid]})
+        except Exception:  # noqa: BLE001 — interpreter may be tearing down
+            pass
+
+    # -- data plane --
+
+    def put(self, value: Any) -> ObjectRef:
+        r = self._rpc.call("client_put", {"blob": ser.dumps(value)})
+        return ObjectRef(ObjectID(r["oid"]))
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        r = self._rpc.call(
+            "client_get",
+            {"oids": [x.binary() for x in ref_list], "timeout": timeout},
+            timeout=None if timeout is None else timeout + 30,
+        )
+        values = []
+        for item in r["results"]:
+            if "error" in item:
+                raise ser.loads(item["error"])
+            values.append(ser.loads(item["blob"]))
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: float | None = None):
+        r = self._rpc.call(
+            "client_wait",
+            {
+                "oids": [x.binary() for x in refs],
+                "num_returns": num_returns,
+                "timeout": timeout,
+            },
+            timeout=None if timeout is None else timeout + 30,
+        )
+        by_id = {x.binary(): x for x in refs}
+        return ([by_id[o] for o in r["ready"]],
+                [by_id[o] for o in r["not_ready"]])
+
+    def as_future(self, ref: ObjectRef):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    # -- task plane --
+
+    def submit_task(self, spec: dict):
+        spec = _wire_safe_spec(spec)
+        r = self._rpc.call("client_submit", {"spec": spec})
+        return [ObjectRef(ObjectID(o)) for o in r["oids"]]
+
+    def submit_actor_task(self, spec: dict, raylet_address: str | None):
+        spec = _wire_safe_spec(spec)
+        r = self._rpc.call(
+            "client_submit_actor",
+            {"spec": spec, "raylet_address": raylet_address},
+        )
+        return [ObjectRef(ObjectID(o)) for o in r["oids"]]
+
+    def shutdown(self) -> None:
+        try:
+            self._rpc.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _wire_safe_spec(spec: dict) -> dict:
+    """Task specs are already msgpack-able dicts of bytes/str/num — assert
+    rather than silently shipping something exotic."""
+    return dict(spec)
+
+
+def connect_client(address: str) -> None:
+    """ray_tpu.init(address="ray://host:port") entry point."""
+    from ray_tpu._private.worker import set_global_worker
+
+    if address.startswith("ray://"):
+        address = address[len("ray://"):]
+    set_global_worker(ClientWorker(address))

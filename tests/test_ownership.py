@@ -179,3 +179,41 @@ def test_spill_restore_roundtrip_store_level(tmp_path):
         client.close()
     finally:
         proc.terminate()
+
+
+def test_min_spilling_size_batches(tmp_path):
+    """With a spill-batch floor, one pressure event spills MULTIPLE small
+    LRU objects in a single pass (config min_spilling_size; reference:
+    local_object_manager.cc batches spills)."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import ObjectStoreClient, start_store
+
+    sock = str(tmp_path / "store.sock")
+    # 4MB store, 256KB objects, 1MB batch floor
+    proc = start_store(sock, 4 * 1024 * 1024,
+                       spill_dir=str(tmp_path / "spill"),
+                       min_spilling_size=1024 * 1024)
+    try:
+        client = ObjectStoreClient(sock)
+        size = 256 * 1024
+        for i in range(16):  # fills the store exactly
+            oid = ObjectID(bytes([i]) * 28)
+            buf = client.create(oid, size)
+            buf[:] = bytes([i]) * size
+            client.seal(oid)
+            client.pin(oid)
+        # one more object forces ONE pressure pass
+        oid = ObjectID(bytes([99]) * 28)
+        buf = client.create(oid, size)
+        buf[:] = bytes([99]) * size
+        client.seal(oid)
+        spilled = [p for p in (tmp_path / "spill").rglob("*") if p.is_file()]
+        # batch floor 1MB / 256KB objects => at least 4 spilled at once
+        assert len(spilled) >= 4, len(spilled)
+        # everything still readable (spilled objects restore on get)
+        for i in range(16):
+            got = client.get(ObjectID(bytes([i]) * 28), timeout_ms=5000)
+            assert bytes(got) == bytes([i]) * size
+        client.close()
+    finally:
+        proc.terminate()

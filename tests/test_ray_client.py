@@ -1,0 +1,137 @@
+"""ray:// client proxy: out-of-cluster drivers
+(model: reference python/ray/tests/test_client.py — init("ray://...") then
+tasks/actors/put/get through the proxy)."""
+import subprocess
+import sys
+import textwrap
+
+import ray_tpu
+
+
+def _client_address():
+    cs = getattr(ray_tpu._node_handle, "client_server", None)
+    assert cs is not None, "head did not start a client server"
+    return "ray://" + cs.address
+
+
+def _run_client(script: str, timeout=180) -> str:
+    r = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(script)],
+        capture_output=True, text=True, timeout=timeout,
+        cwd="/root/repo",
+    )
+    assert r.returncode == 0, f"stderr: {r.stderr[-2000:]}"
+    return r.stdout
+
+
+def test_client_tasks_put_get(ray_start):
+    out = _run_client(f"""
+        import ray_tpu
+        ray_tpu.init(address={_client_address()!r})
+
+        @ray_tpu.remote
+        def square(x):
+            return x * x
+
+        refs = [square.remote(i) for i in range(5)]
+        print("tasks:", ray_tpu.get(refs, timeout=120))
+
+        ref = ray_tpu.put({{"k": [1, 2, 3]}})
+        print("put:", ray_tpu.get(ref, timeout=60))
+
+        ready, not_ready = ray_tpu.wait(refs, num_returns=5, timeout=60)
+        print("wait:", len(ready), len(not_ready))
+        ray_tpu.shutdown()
+    """)
+    assert "tasks: [0, 1, 4, 9, 16]" in out
+    assert "put: {'k': [1, 2, 3]}" in out
+    assert "wait: 5 0" in out
+
+
+def test_client_actors(ray_start):
+    out = _run_client(f"""
+        import ray_tpu
+        ray_tpu.init(address={_client_address()!r})
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        c = Counter.remote()
+        for i in range(4):
+            last = c.add.remote(2)
+        print("count:", ray_tpu.get(last, timeout=120))
+        ray_tpu.kill(c)
+        ray_tpu.shutdown()
+    """)
+    assert "count: 8" in out
+
+
+def test_client_task_error_propagates(ray_start):
+    out = _run_client(f"""
+        import ray_tpu
+        ray_tpu.init(address={_client_address()!r})
+
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("client-visible failure")
+
+        try:
+            ray_tpu.get(boom.remote(), timeout=120)
+            print("no error")
+        except Exception as e:
+            print("error:", type(e).__name__, "client-visible failure" in str(e))
+        ray_tpu.shutdown()
+    """)
+    assert "error:" in out and "True" in out
+
+
+def test_client_state_api(ray_start):
+    out = _run_client(f"""
+        import ray_tpu
+        ray_tpu.init(address={_client_address()!r})
+        print("cpus:", ray_tpu.cluster_resources().get("CPU", 0) > 0)
+        print("nodes:", len(ray_tpu.nodes()) >= 1)
+        ray_tpu.shutdown()
+    """)
+    assert "cpus: True" in out
+    assert "nodes: True" in out
+
+
+def test_client_via_cli_node_process():
+    """Full out-of-cluster path: a `ray_tpu start --head`-style node
+    PROCESS with a client server, driven by a separate ray:// driver
+    process (reference: ray start --head + ray.init("ray://...."))."""
+    import json
+    import os
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_main", "--head",
+         "--num-cpus", "2", "--client-server-port", "0"],
+        stdout=subprocess.PIPE, cwd="/root/repo",
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        assert "RAY_TPU_NODE_READY" in line, line
+        info = json.loads(line.split(" ", 1)[1])
+        assert info["client_address"]
+        out = _run_client(f"""
+            import ray_tpu
+            ray_tpu.init(address="ray://{info['client_address']}")
+
+            @ray_tpu.remote
+            def f(x):
+                return x + 1
+
+            print("result:", ray_tpu.get(f.remote(41), timeout=90))
+            ray_tpu.shutdown()
+        """)
+        assert "result: 42" in out
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
