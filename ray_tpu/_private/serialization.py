@@ -24,6 +24,21 @@ import cloudpickle
 # Buffers smaller than this are kept in-band; the indirection isn't worth it.
 _OOB_THRESHOLD = 4096
 
+# Cross-language (XLANG) envelope: the nbuf slot carries this sentinel and
+# the meta bytes are msgpack instead of pickle. Non-Python frontends
+# (cpp/frontend.cpp) produce and consume ONLY this format — the analog of
+# the reference's msgpack cross-language serialization
+# (src/ray/common/function_descriptor.h + java/cpp worker serializers).
+XLANG_NBUF = 0xFFFFFFFF
+
+
+def serialize_xlang(value: Any) -> list[bytes]:
+    """Serialize msgpack-able values for cross-language consumers."""
+    import msgpack
+
+    meta = msgpack.packb(value, use_bin_type=True)
+    return [struct.pack("<IQ", XLANG_NBUF, len(meta)), meta]
+
 
 def serialize(value: Any) -> list[bytes | memoryview]:
     """Serialize to a list of chunks: header + meta + raw buffers.
@@ -86,6 +101,10 @@ def deserialize(data: bytes | memoryview) -> Any:
     nbuf, meta_len = struct.unpack_from("<IQ", view, 0)
     offset = 12
     meta = view[offset : offset + meta_len]
+    if nbuf == XLANG_NBUF:
+        import msgpack
+
+        return msgpack.unpackb(bytes(meta), raw=False)
     offset += meta_len
     out_of_band = []
     for _ in range(nbuf):

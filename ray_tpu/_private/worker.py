@@ -208,8 +208,11 @@ class CoreWorker:
             self._owned.add(oid.binary())
         return ObjectRef(oid)
 
-    def put_object(self, oid: ObjectID, value: Any, pin: bool = True) -> None:
-        chunks = ser.serialize(value)
+    def put_object(self, oid: ObjectID, value: Any, pin: bool = True,
+                   xlang: bool = False) -> None:
+        # xlang: msgpack envelope readable by non-Python frontends
+        # (requested by cross-language task specs — serialization.py)
+        chunks = ser.serialize_xlang(value) if xlang else ser.serialize(value)
         size = ser.serialized_size(chunks)
         try:
             buf = self.store.create(oid, size)
@@ -570,9 +573,10 @@ class CoreWorker:
                     f"task {spec['name']} declared num_returns={n} but returned "
                     f"{len(values)} values"
                 )
+        xlang = bool(spec.get("xlang"))
         for oid, v in zip(oids, values):
             try:
-                self.put_object(oid, v)
+                self.put_object(oid, v, xlang=xlang)
             except ValueError:
                 pass  # duplicate execution (retry landed first) — keep first
 
@@ -593,7 +597,21 @@ class CoreWorker:
         fid = spec["function_id"]
         fn = self._function_cache.get(fid)
         if fn is None:
-            fn = ts.loads_function(spec["function_blob"])
+            desc = spec.get("function_desc")
+            if spec.get("function_blob"):
+                fn = ts.loads_function(spec["function_blob"])
+            elif desc:
+                # cross-language submission: "module:callable" descriptor
+                # instead of a pickled blob (reference:
+                # function_descriptor.h PythonFunctionDescriptor)
+                import importlib
+
+                mod_name, _, attr = desc.partition(":")
+                fn = getattr(importlib.import_module(mod_name), attr)
+            else:
+                raise ValueError(
+                    f"task {spec['name']} has neither function_blob nor "
+                    f"function_desc")
             self._function_cache[fid] = fn
         return fn
 

@@ -1,0 +1,633 @@
+// C++ frontend for the ray_tpu runtime.
+//
+// Equivalent in role to the reference's C++ worker/API layer (reference:
+// cpp/include/ray/api.h — ray::Init / ray::Put / ray::Get /
+// ray::Task(...).Remote() over the core worker; cross-language calls use
+// function DESCRIPTORS plus msgpack-serialized values,
+// src/ray/common/function_descriptor.h). Here the same three planes are
+// spoken natively:
+//
+//   * control plane  — msgpack-framed RPC to the GCS and raylet
+//                      (_private/rpc.py wire format, incl. the _handshake
+//                      protocol check from _private/schema.py);
+//   * object plane   — the shm store daemon's unix-socket protocol
+//                      (cpp/store.cpp framing), values mmap'd directly;
+//   * task plane     — task specs built as msgpack maps with a
+//                      "function_desc" ("module:callable") instead of a
+//                      pickled blob, and XLANG (msgpack) args/returns —
+//                      the exact cross-language contract the Python worker
+//                      honors (_private/worker.py _load_function,
+//                      _private/serialization.py XLANG envelope).
+//
+// Classes (embed these in an application; the main() below is the demo
+// driver the tests run):
+//   msgpk::Writer / msgpk::Value  — minimal msgpack codec (subset)
+//   RpcClient                     — blocking control-plane RPC
+//   StoreClient                   — object create/seal/get via shm
+//   RayTpuClient                  — Init / Put / Get / Submit / Kv*
+//
+// Build: g++ -O2 -std=c++17 -pthread -o frontend frontend.cpp -lrt
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace msgpk {
+
+// ---------------------------------------------------------------------------
+// Writer: canonical wide encodings (the Python msgpack lib accepts any
+// well-formed width, so fixed-width keeps the encoder tiny).
+// ---------------------------------------------------------------------------
+
+struct Writer {
+  std::string out;
+
+  void nil() { out.push_back((char)0xc0); }
+  void boolean(bool b) { out.push_back((char)(b ? 0xc3 : 0xc2)); }
+  void i64(int64_t v) {
+    out.push_back((char)0xd3);
+    be64((uint64_t)v);
+  }
+  void f64(double v) {
+    out.push_back((char)0xcb);
+    uint64_t bits;
+    memcpy(&bits, &v, 8);
+    be64(bits);
+  }
+  void str(const std::string &s) {
+    out.push_back((char)0xdb);
+    be32((uint32_t)s.size());
+    out += s;
+  }
+  void bin(const std::string &s) {
+    out.push_back((char)0xc6);
+    be32((uint32_t)s.size());
+    out += s;
+  }
+  void array(uint32_t n) {
+    out.push_back((char)0xdd);
+    be32(n);
+  }
+  void map(uint32_t n) {
+    out.push_back((char)0xdf);
+    be32(n);
+  }
+
+ private:
+  void be32(uint32_t v) {
+    for (int i = 3; i >= 0; --i) out.push_back((char)((v >> (8 * i)) & 0xff));
+  }
+  void be64(uint64_t v) {
+    for (int i = 7; i >= 0; --i) out.push_back((char)((v >> (8 * i)) & 0xff));
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Value + parser (subset: everything the control plane emits)
+// ---------------------------------------------------------------------------
+
+struct Value {
+  enum Type { NIL, BOOL, INT, FLOAT, STR, BIN, ARR, MAP } type = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0;
+  std::string s;  // STR and BIN payloads
+  std::vector<Value> arr;
+  std::vector<std::pair<Value, Value>> map;
+
+  const Value *get(const std::string &key) const {
+    for (auto &kv : map)
+      if (kv.first.type == STR && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+  bool truthy() const {
+    switch (type) {
+      case BOOL: return b;
+      case INT: return i != 0;
+      case NIL: return false;
+      default: return true;
+    }
+  }
+};
+
+struct Parser {
+  const uint8_t *p, *end;
+  explicit Parser(const std::string &buf)
+      : p((const uint8_t *)buf.data()), end(p + buf.size()) {}
+
+  Value parse() {
+    need(1);
+    uint8_t t = *p++;
+    Value v;
+    if (t <= 0x7f) {  // positive fixint
+      v.type = Value::INT; v.i = t; return v;
+    }
+    if (t >= 0xe0) {  // negative fixint
+      v.type = Value::INT; v.i = (int8_t)t; return v;
+    }
+    if ((t & 0xf0) == 0x80) return map_body(t & 0x0f);
+    if ((t & 0xf0) == 0x90) return arr_body(t & 0x0f);
+    if ((t & 0xe0) == 0xa0) return str_body(t & 0x1f);
+    switch (t) {
+      case 0xc0: return v;
+      case 0xc2: v.type = Value::BOOL; v.b = false; return v;
+      case 0xc3: v.type = Value::BOOL; v.b = true; return v;
+      case 0xc4: return bin_body(u(1));
+      case 0xc5: return bin_body(u(2));
+      case 0xc6: return bin_body(u(4));
+      case 0xca: {
+        uint32_t bits = (uint32_t)u(4); float f; memcpy(&f, &bits, 4);
+        v.type = Value::FLOAT; v.d = f; return v;
+      }
+      case 0xcb: {
+        uint64_t bits = u(8); double dd; memcpy(&dd, &bits, 8);
+        v.type = Value::FLOAT; v.d = dd; return v;
+      }
+      case 0xcc: v.type = Value::INT; v.i = (int64_t)u(1); return v;
+      case 0xcd: v.type = Value::INT; v.i = (int64_t)u(2); return v;
+      case 0xce: v.type = Value::INT; v.i = (int64_t)u(4); return v;
+      case 0xcf: v.type = Value::INT; v.i = (int64_t)u(8); return v;
+      case 0xd0: v.type = Value::INT; v.i = (int8_t)u(1); return v;
+      case 0xd1: v.type = Value::INT; v.i = (int16_t)u(2); return v;
+      case 0xd2: v.type = Value::INT; v.i = (int32_t)u(4); return v;
+      case 0xd3: v.type = Value::INT; v.i = (int64_t)u(8); return v;
+      case 0xd9: return str_body(u(1));
+      case 0xda: return str_body(u(2));
+      case 0xdb: return str_body(u(4));
+      case 0xdc: return arr_body(u(2));
+      case 0xdd: return arr_body(u(4));
+      case 0xde: return map_body(u(2));
+      case 0xdf: return map_body(u(4));
+      default: throw std::runtime_error("msgpack: unsupported tag");
+    }
+  }
+
+ private:
+  void need(size_t n) {
+    if ((size_t)(end - p) < n) throw std::runtime_error("msgpack: truncated");
+  }
+  uint64_t u(int nbytes) {
+    need(nbytes);
+    uint64_t v = 0;
+    for (int i = 0; i < nbytes; ++i) v = (v << 8) | *p++;
+    return v;
+  }
+  Value str_body(uint64_t n) {
+    need(n);
+    Value v; v.type = Value::STR; v.s.assign((const char *)p, n); p += n;
+    return v;
+  }
+  Value bin_body(uint64_t n) {
+    need(n);
+    Value v; v.type = Value::BIN; v.s.assign((const char *)p, n); p += n;
+    return v;
+  }
+  Value arr_body(uint64_t n) {
+    Value v; v.type = Value::ARR;
+    for (uint64_t i = 0; i < n; ++i) v.arr.push_back(parse());
+    return v;
+  }
+  Value map_body(uint64_t n) {
+    Value v; v.type = Value::MAP;
+    for (uint64_t i = 0; i < n; ++i) {
+      Value k = parse();
+      v.map.emplace_back(std::move(k), parse());
+    }
+    return v;
+  }
+};
+
+}  // namespace msgpk
+
+// ---------------------------------------------------------------------------
+// socket helpers
+// ---------------------------------------------------------------------------
+
+static bool WriteExact(int fd, const void *buf, size_t n) {
+  const char *b = (const char *)buf;
+  while (n) {
+    ssize_t w = write(fd, b, n);
+    if (w <= 0) return false;
+    b += w; n -= w;
+  }
+  return true;
+}
+
+static bool ReadExact(int fd, void *buf, size_t n) {
+  char *b = (char *)buf;
+  while (n) {
+    ssize_t r = read(fd, b, n);
+    if (r <= 0) return false;
+    b += r; n -= r;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// RpcClient — the _private/rpc.py wire format ([u32 len][msgpack array])
+// ---------------------------------------------------------------------------
+
+class RpcClient {
+ public:
+  explicit RpcClient(const std::string &address) {
+    auto colon = address.rfind(':');
+    std::string host = address.substr(0, colon);
+    std::string port = address.substr(colon + 1);
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res)
+      throw std::runtime_error("resolve failed: " + address);
+    fd_ = socket(res->ai_family, res->ai_socktype, 0);
+    if (fd_ < 0 || connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+      freeaddrinfo(res);
+      throw std::runtime_error("connect failed: " + address);
+    }
+    freeaddrinfo(res);
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+    Handshake();
+  }
+  ~RpcClient() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  // payload_msgpack: pre-encoded msgpack for the payload slot
+  msgpk::Value Call(const std::string &method,
+                    const std::string &payload_msgpack) {
+    uint64_t id = ++msgid_;
+    msgpk::Writer w;
+    w.array(4);
+    w.i64(0);  // REQUEST
+    w.i64((int64_t)id);
+    w.str(method);
+    w.out += payload_msgpack;
+    SendFrame(w.out);
+    for (;;) {
+      msgpk::Value msg = ReadFrame();
+      if (msg.arr.size() != 4) continue;
+      int64_t mtype = msg.arr[0].i;
+      if (mtype != 1) continue;  // skip NOTIFY pushes
+      if ((uint64_t)msg.arr[1].i != id) continue;
+      if (!msg.arr[2].truthy())
+        throw std::runtime_error("rpc " + method + " failed: " +
+                                 msg.arr[3].s.substr(0, 400));
+      return std::move(msg.arr[3]);
+    }
+  }
+
+ private:
+  void Handshake() {
+    // schema.py handshake_payload(): {"protocol": N, "version": "..."}
+    msgpk::Writer p;
+    p.map(2);
+    p.str("protocol");
+    p.i64(1);  // PROTOCOL_VERSION (schema.py) — bump together
+    p.str("version");
+    p.str("cpp-frontend");
+    Call("_handshake", p.out);
+  }
+  void SendFrame(const std::string &body) {
+    uint32_t len = (uint32_t)body.size();  // little-endian, matches rpc.py
+    char hdr[4];
+    memcpy(hdr, &len, 4);
+    if (!WriteExact(fd_, hdr, 4) || !WriteExact(fd_, body.data(), body.size()))
+      throw std::runtime_error("rpc send failed");
+  }
+  msgpk::Value ReadFrame() {
+    char hdr[4];
+    if (!ReadExact(fd_, hdr, 4)) throw std::runtime_error("rpc recv failed");
+    uint32_t len;
+    memcpy(&len, hdr, 4);
+    std::string body(len, '\0');
+    if (!ReadExact(fd_, body.data(), len))
+      throw std::runtime_error("rpc recv failed");
+    msgpk::Parser parser(body);
+    return parser.parse();
+  }
+
+  int fd_ = -1;
+  uint64_t msgid_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// StoreClient — cpp/store.cpp unix-socket protocol
+// ---------------------------------------------------------------------------
+
+class StoreClient {
+ public:
+  static constexpr size_t kIdSize = 28;
+
+  explicit StoreClient(const std::string &socket_path) {
+    fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
+    if (fd_ < 0 || connect(fd_, (sockaddr *)&addr, sizeof(addr)) != 0)
+      throw std::runtime_error("store connect failed: " + socket_path);
+  }
+  ~StoreClient() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  // Put: CREATE + memcpy into the shm mapping + SEAL.
+  void Put(const std::string &id, const std::string &payload) {
+    std::string req;
+    uint64_t size = payload.size();
+    req.append((const char *)&size, 8);
+    auto resp = Op(1 /*CREATE*/, id, req);
+    if (resp.first != 0)
+      throw std::runtime_error("store create failed, status " +
+                               std::to_string(resp.first));
+    const std::string &shm_name = resp.second;
+    int sfd = shm_open(shm_name.c_str(), O_RDWR, 0600);
+    if (sfd < 0) throw std::runtime_error("shm_open failed: " + shm_name);
+    if (size) {
+      void *m = mmap(nullptr, size, PROT_WRITE, MAP_SHARED, sfd, 0);
+      close(sfd);
+      if (m == MAP_FAILED) throw std::runtime_error("mmap failed");
+      memcpy(m, payload.data(), size);
+      munmap(m, size);
+    } else {
+      close(sfd);
+    }
+    auto seal = Op(2 /*SEAL*/, id, std::string(1, '\0'));  // pin=false
+    if (seal.first != 0)
+      throw std::runtime_error("store seal failed");
+  }
+
+  // Get: blocks in the daemon until sealed or timeout.
+  std::string Get(const std::string &id, uint64_t timeout_ms) {
+    std::string req((const char *)&timeout_ms, 8);
+    auto resp = Op(3 /*GET*/, id, req);
+    if (resp.first == 4) throw std::runtime_error("store get timeout");
+    if (resp.first != 0)
+      throw std::runtime_error("store get failed, status " +
+                               std::to_string(resp.first));
+    uint64_t size;
+    memcpy(&size, resp.second.data(), 8);
+    std::string shm_name = resp.second.substr(8);
+    std::string out;
+    if (size) {
+      int sfd = shm_open(shm_name.c_str(), O_RDONLY, 0600);
+      if (sfd < 0) throw std::runtime_error("shm_open failed: " + shm_name);
+      void *m = mmap(nullptr, size, PROT_READ, MAP_SHARED, sfd, 0);
+      close(sfd);
+      if (m == MAP_FAILED) throw std::runtime_error("mmap failed");
+      out.assign((const char *)m, size);
+      munmap(m, size);
+    }
+    Op(4 /*RELEASE*/, id, "");
+    return out;
+  }
+
+  bool Contains(const std::string &id) {
+    return Op(6 /*CONTAINS*/, id, "").first == 0;
+  }
+
+ private:
+  std::pair<uint8_t, std::string> Op(uint8_t op, const std::string &id,
+                                     const std::string &payload) {
+    if (id.size() != kIdSize) throw std::runtime_error("bad object id size");
+    uint32_t len = (uint32_t)(1 + kIdSize + payload.size());
+    std::string req;
+    req.append((const char *)&len, 4);
+    req.push_back((char)op);
+    req += id;
+    req += payload;
+    if (!WriteExact(fd_, req.data(), req.size()))
+      throw std::runtime_error("store send failed");
+    char hdr[4];
+    if (!ReadExact(fd_, hdr, 4)) throw std::runtime_error("store recv failed");
+    uint32_t rlen;
+    memcpy(&rlen, hdr, 4);
+    std::string body(rlen, '\0');
+    if (rlen && !ReadExact(fd_, body.data(), rlen))
+      throw std::runtime_error("store recv failed");
+    uint8_t status = (uint8_t)body[0];
+    return {status, body.substr(1)};
+  }
+
+  int fd_ = -1;
+};
+
+// ---------------------------------------------------------------------------
+// RayTpuClient — the frontend API
+// ---------------------------------------------------------------------------
+
+class RayTpuClient {
+ public:
+  RayTpuClient(const std::string &gcs_address, const std::string &store_socket)
+      : gcs_(gcs_address), store_(store_socket) {
+    // job + driver-task identity (ids.py: JobID 4B; driver TaskID =
+    // 20 zero bytes + job)
+    msgpk::Writer empty;
+    empty.nil();
+    auto r = gcs_.Call("next_job_id", empty.out);
+    const msgpk::Value *jid = r.get("job_id");
+    if (!jid) throw std::runtime_error("next_job_id: no job_id");
+    job_id_ = jid->s;
+    driver_task_ = std::string(20, '\0') + job_id_;
+    // raylet address from the node table
+    auto nodes = gcs_.Call("get_nodes", empty.out);
+    const msgpk::Value *arr = nodes.get("nodes");
+    if (!arr || arr->arr.empty())
+      throw std::runtime_error("no nodes registered");
+    const msgpk::Value *addr = arr->arr[0].get("address");
+    raylet_ = std::make_unique<RpcClient>(addr->s);
+  }
+
+  // -- kv --
+  void KvPut(const std::string &key, const std::string &value) {
+    msgpk::Writer p;
+    p.map(2);
+    p.str("key"); p.bin(key);
+    p.str("value"); p.bin(value);
+    gcs_.Call("kv_put", p.out);
+  }
+  std::string KvGet(const std::string &key) {
+    msgpk::Writer p;
+    p.map(1);
+    p.str("key"); p.bin(key);
+    auto r = gcs_.Call("kv_get", p.out);
+    const msgpk::Value *v = r.get("value");
+    return v ? v->s : "";
+  }
+
+  size_t NumNodes() {
+    msgpk::Writer empty;
+    empty.nil();
+    auto nodes = gcs_.Call("get_nodes", empty.out);
+    return nodes.get("nodes")->arr.size();
+  }
+
+  // -- objects (XLANG envelope: [u32 0xFFFFFFFF][u64 len][msgpack]) --
+  std::string Put(const std::string &value_msgpack) {
+    std::string id = NextObjectId(true);
+    store_.Put(id, XlangEnvelope(value_msgpack));
+    return id;
+  }
+
+  msgpk::Value Get(const std::string &id, uint64_t timeout_ms) {
+    std::string payload = store_.Get(id, timeout_ms);
+    if (payload.size() < 12) throw std::runtime_error("short object");
+    uint32_t nbuf;
+    memcpy(&nbuf, payload.data(), 4);
+    if (nbuf != 0xFFFFFFFFu)
+      throw std::runtime_error(
+          "object is not cross-language (pickled by a Python worker without "
+          "xlang=true)");
+    uint64_t len;
+    memcpy(&len, payload.data() + 4, 8);
+    std::string body = payload.substr(12, len);
+    msgpk::Parser parser(body);
+    return parser.parse();
+  }
+
+  // -- tasks: function descriptor + msgpack args; returns the result oid --
+  std::string Submit(const std::string &func_desc,
+                     const std::string &args_msgpack_array,
+                     double num_cpus = 1.0) {
+    std::string task_id = RandomBytes(20) + job_id_;  // TaskID.for_task
+    // args_blob = XLANG msgpack of [args, kwargs]
+    msgpk::Writer args;
+    args.array(2);
+    args.out += args_msgpack_array;
+    args.map(0);  // kwargs
+    msgpk::Writer spec;
+    spec.map(22);
+    spec.str("task_id"); spec.bin(task_id);
+    spec.str("job_id"); spec.bin(job_id_);
+    spec.str("name"); spec.str(func_desc);
+    spec.str("type"); spec.str("normal");
+    spec.str("function_blob"); spec.nil();
+    spec.str("function_desc"); spec.str(func_desc);
+    spec.str("function_id"); spec.bin(func_desc);  // cache key
+    spec.str("method_name"); spec.nil();
+    spec.str("args_blob"); spec.bin(XlangEnvelope(args.out));
+    spec.str("arg_deps"); spec.array(0);
+    spec.str("num_returns"); spec.i64(1);
+    spec.str("streaming"); spec.boolean(false);
+    spec.str("resources");
+    spec.map(1); spec.str("CPU"); spec.f64(num_cpus);
+    spec.str("actor_id"); spec.nil();
+    spec.str("seqno"); spec.i64(0);
+    spec.str("max_retries"); spec.i64(0);
+    spec.str("retry_count"); spec.i64(0);
+    spec.str("placement"); spec.nil();
+    spec.str("scheduling");
+    spec.map(1); spec.str("type"); spec.str("default");
+    spec.str("runtime_env"); spec.nil();
+    spec.str("xlang"); spec.boolean(true);  // msgpack returns
+    spec.str("owner_address"); spec.str("");
+    msgpk::Writer p;
+    p.map(1);
+    p.str("spec");
+    p.out += spec.out;
+    auto r = raylet_->Call("submit_task", p.out);
+    if (!r.get("ok") || !r.get("ok")->truthy())
+      throw std::runtime_error("submit_task rejected");
+    return task_id + std::string("\x00\x00\x00\x00", 4);  // return index 0
+  }
+
+ private:
+  static std::string RandomBytes(size_t n) {
+    std::string out(n, '\0');
+    FILE *f = fopen("/dev/urandom", "rb");
+    if (!f || fread(out.data(), 1, n, f) != n)
+      throw std::runtime_error("urandom failed");
+    fclose(f);
+    return out;
+  }
+  std::string NextObjectId(bool is_put) {
+    uint32_t idx = ++put_index_;
+    if (is_put) idx |= 0x80000000u;  // ObjectID.PUT_BIT
+    std::string id = driver_task_;
+    id.append((const char *)&idx, 4);  // little-endian
+    return id;
+  }
+  static std::string XlangEnvelope(const std::string &msgpack_bytes) {
+    std::string out;
+    uint32_t sentinel = 0xFFFFFFFFu;
+    uint64_t len = msgpack_bytes.size();
+    out.append((const char *)&sentinel, 4);
+    out.append((const char *)&len, 8);
+    out += msgpack_bytes;
+    return out;
+  }
+
+  RpcClient gcs_;
+  StoreClient store_;
+  std::unique_ptr<RpcClient> raylet_;
+  std::string job_id_, driver_task_;
+  uint32_t put_index_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// demo driver (what tests/test_cpp_frontend.py runs)
+// ---------------------------------------------------------------------------
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s <gcs_addr> <store_sock> <kv|putget|submit> [args]\n",
+            argv[0]);
+    return 2;
+  }
+  try {
+    RayTpuClient client(argv[1], argv[2]);
+    std::string cmd = argv[3];
+    if (cmd == "kv") {
+      client.KvPut("cpp_key", "cpp_value");
+      printf("kv:%s\n", client.KvGet("cpp_key").c_str());
+      printf("nodes:%zu\n", client.NumNodes());
+      return 0;
+    }
+    if (cmd == "putget") {
+      msgpk::Writer v;
+      v.map(2);
+      v.str("msg"); v.str("hello from c++");
+      v.str("n"); v.i64(1234);
+      std::string oid = client.Put(v.out);
+      msgpk::Value back = client.Get(oid, 10000);
+      printf("putget:%s:%lld\n", back.get("msg")->s.c_str(),
+             (long long)back.get("n")->i);
+      // print the oid hex so Python can fetch the same object
+      for (unsigned char c : oid) printf("%02x", c);
+      printf("\n");
+      return 0;
+    }
+    if (cmd == "submit") {
+      // submit <module:callable> <int> <int> — two integer args
+      msgpk::Writer args;
+      args.array(2);
+      args.i64(atoll(argv[5]));
+      args.i64(atoll(argv[6]));
+      std::string oid = client.Submit(argv[4], args.out);
+      msgpk::Value result = client.Get(oid, 60000);
+      if (result.type == msgpk::Value::FLOAT)
+        printf("result:%.6f\n", result.d);
+      else
+        printf("result:%lld\n", (long long)result.i);
+      return 0;
+    }
+    fprintf(stderr, "unknown command %s\n", cmd.c_str());
+    return 2;
+  } catch (const std::exception &e) {
+    fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}
