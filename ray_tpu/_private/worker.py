@@ -530,11 +530,13 @@ class CoreWorker:
         self._last_task_failed = False
         from ray_tpu._private.runtime_env import applied_runtime_env
 
+        from ray_tpu.util.tracing import task_span
+
         try:
             with applied_runtime_env(
                 spec.get("runtime_env"),
                 permanent=spec["type"] == ts.ACTOR_CREATION,
-            ):
+            ), task_span(spec):
                 if spec["type"] == ts.ACTOR_CREATION:
                     self._execute_actor_creation(spec)
                 elif spec["type"] == ts.ACTOR_TASK:

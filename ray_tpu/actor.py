@@ -127,6 +127,11 @@ class ActorMethod:
             actor_id=h._actor_id,
             seqno=worker.next_actor_seqno(h._actor_id),
         )
+        from ray_tpu.util.tracing import current_context
+
+        trace_ctx = current_context()
+        if trace_ctx is not None:
+            spec["trace_ctx"] = trace_ctx
         try:
             refs = worker.submit_actor_task(spec, raylet_addr)
         except ConnectionError:

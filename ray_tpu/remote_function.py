@@ -85,6 +85,11 @@ class RemoteFunction:
             scheduling=scheduling,
             runtime_env=self._runtime_env,
         )
+        from ray_tpu.util.tracing import current_context
+
+        trace_ctx = current_context()
+        if trace_ctx is not None:
+            spec["trace_ctx"] = trace_ctx
         refs = worker.submit_task(spec)
         if streaming:
             from ray_tpu._private.generator import ObjectRefGenerator

@@ -1,0 +1,144 @@
+"""Distributed tracing — application spans propagated through task calls.
+
+Equivalent of the reference's OpenTelemetry integration (reference:
+python/ray/util/tracing/tracing_helper.py — trace context injected into
+task metadata at submission, child spans opened around remote execution).
+No external SDK: spans ride the existing task-event plane (SPAN events in
+the task-event buffer → GCS), and export to the same chrome-trace format
+as `state.timeline()`. Semantics follow OTel: a span is a named, timed
+block; spans nest via a contextvar; a task submitted inside a span carries
+the trace context, and its execution on the worker becomes a child span.
+
+    from ray_tpu.util import tracing
+
+    with tracing.span("ingest", source="s3"):
+        refs = [preprocess.remote(x) for x in shards]   # children
+        ray_tpu.get(refs)
+    tracing.trace_to_chrome(trace_id, "trace.json")
+"""
+from __future__ import annotations
+
+import contextvars
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+# (trace_id_hex, span_id_hex) of the innermost active span
+_current: contextvars.ContextVar[Optional[tuple[str, str]]] = (
+    contextvars.ContextVar("ray_tpu_span", default=None)
+)
+
+
+def current_context() -> Optional[dict]:
+    """Trace context to inject into an outgoing task spec (None when no
+    span is active — tracing is opt-in per call tree, so untraced
+    workloads pay nothing)."""
+    cur = _current.get()
+    if cur is None:
+        return None
+    return {"trace_id": cur[0], "parent_span_id": cur[1]}
+
+
+def _record(name: str, trace_id: str, span_id: str,
+            parent_span_id: str | None, start: float, end: float,
+            attrs: dict | None, kind: str) -> None:
+    from ray_tpu._private.worker import global_worker_or_none
+
+    w = global_worker_or_none()
+    if w is None or getattr(w, "task_events", None) is None:
+        return
+    task_id = b"\x00" * 24
+    job_id = b"\x00" * 4
+    try:
+        if w.task_id is not None:
+            task_id = w.task_id.binary()
+        job_id = w.job_id.binary()
+    except Exception:  # noqa: BLE001 — identity is best-effort metadata
+        pass
+    w.task_events.record(
+        task_id=task_id, job_id=job_id, name=name, event="SPAN",
+        task_type=kind,
+        extra={
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_span_id": parent_span_id,
+            "start": start,
+            "end": end,
+            "attrs": attrs or {},
+        },
+    )
+
+
+@contextmanager
+def span(name: str, **attrs: Any):
+    """Open a span; nests under the active one; records on exit."""
+    parent = _current.get()
+    trace_id = parent[0] if parent else os.urandom(8).hex()
+    span_id = os.urandom(8).hex()
+    token = _current.set((trace_id, span_id))
+    start = time.time()
+    try:
+        yield {"trace_id": trace_id, "span_id": span_id}
+    finally:
+        _current.reset(token)
+        _record(name, trace_id, span_id, parent[1] if parent else None,
+                start, time.time(), attrs, kind="span")
+
+
+@contextmanager
+def task_span(spec: dict):
+    """Worker-side: wrap task execution as a child span when the submitter
+    carried a trace context (no-op otherwise)."""
+    ctx = spec.get("trace_ctx")
+    if not ctx:
+        yield
+        return
+    span_id = os.urandom(8).hex()
+    token = _current.set((ctx["trace_id"], span_id))
+    start = time.time()
+    try:
+        yield
+    finally:
+        _current.reset(token)
+        _record(spec["name"], ctx["trace_id"], span_id,
+                ctx.get("parent_span_id"), start, time.time(),
+                {"task_id": spec["task_id"].hex()}, kind="task")
+
+
+def get_trace(trace_id: str) -> list[dict]:
+    """All recorded spans of one trace (driver-side, via the GCS)."""
+    from ray_tpu.util.state import _task_events
+
+    return [
+        e for e in _task_events()
+        if e.get("event") == "SPAN" and e.get("trace_id") == trace_id
+    ]
+
+
+def trace_to_chrome(trace_id: str, filename: str | None = None):
+    """Export one trace as chrome://tracing events (the same consumer as
+    state.timeline())."""
+    import json
+
+    events = []
+    for e in sorted(get_trace(trace_id), key=lambda e: e["start"]):
+        events.append({
+            "name": e["name"],
+            "cat": e.get("type", "span"),
+            "ph": "X",
+            "ts": e["start"] * 1e6,
+            "dur": (e["end"] - e["start"]) * 1e6,
+            "pid": e.get("node_id", "")[:8],
+            "tid": e.get("worker_id", "")[:8],
+            "args": {
+                "span_id": e["span_id"],
+                "parent_span_id": e.get("parent_span_id"),
+                **(e.get("attrs") or {}),
+            },
+        })
+    if filename is None:
+        return events
+    with open(filename, "w") as f:
+        json.dump(events, f)
+    return None

@@ -1,0 +1,69 @@
+"""Tracing spans: nesting, task propagation, chrome export
+(model: reference python/ray/tests/test_tracing.py — spans around remote
+calls with propagated context)."""
+import time
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def test_span_nesting_and_trace_retrieval(ray_start):
+    with tracing.span("root", app="test") as root:
+        trace_id = root["trace_id"]
+        with tracing.span("child"):
+            time.sleep(0.01)
+    deadline = time.monotonic() + 10
+    spans = []
+    while time.monotonic() < deadline:
+        spans = tracing.get_trace(trace_id)
+        if len(spans) >= 2:
+            break
+        time.sleep(0.3)
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"root", "child"}
+    assert by_name["child"]["parent_span_id"] == by_name["root"]["span_id"]
+    assert by_name["root"]["parent_span_id"] is None
+    assert by_name["root"]["attrs"] == {"app": "test"}
+    assert by_name["root"]["end"] >= by_name["child"]["end"]
+
+
+def test_task_execution_becomes_child_span(ray_start):
+    @ray_tpu.remote
+    def traced_work(x):
+        return x + 1
+
+    with tracing.span("driver-block") as root:
+        trace_id = root["trace_id"]
+        assert ray_tpu.get(traced_work.remote(1), timeout=60) == 2
+    deadline = time.monotonic() + 15
+    spans = []
+    while time.monotonic() < deadline:
+        spans = tracing.get_trace(trace_id)
+        if len(spans) >= 2:
+            break
+        time.sleep(0.3)
+    names = {s["name"] for s in spans}
+    assert "driver-block" in names and "traced_work" in names
+    task_span = [s for s in spans if s["name"] == "traced_work"][0]
+    parent = [s for s in spans if s["name"] == "driver-block"][0]
+    assert task_span["parent_span_id"] == parent["span_id"]
+    assert task_span["type"] == "task"
+    # chrome export shape
+    events = tracing.trace_to_chrome(trace_id)
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+
+
+def test_untraced_tasks_record_no_spans(ray_start):
+    @ray_tpu.remote
+    def plain():
+        return 1
+
+    assert ray_tpu.get(plain.remote(), timeout=60) == 1
+    # no active span at submission => no trace context, no SPAN events for
+    # this task (tracing is opt-in per call tree)
+    from ray_tpu.util.state import _task_events
+
+    time.sleep(1.0)
+    spans = [e for e in _task_events() if e.get("event") == "SPAN"
+             and e.get("name") == "plain"]
+    assert spans == []
