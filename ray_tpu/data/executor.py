@@ -298,17 +298,27 @@ def run_all_to_all(
         if n_out == 1:
             refs = [refs]
         map_out.append(list(refs))
-    out: List[RefBundle] = []
-    pending = []
+    out: List[Optional[RefBundle]] = [None] * n_out
+    pending: dict = {}  # meta_ref -> (j, block_ref)
     for j in range(n_out):
         parts = [map_out[i][j] for i in range(n_in)]
         block_ref, meta_ref = _exec_reduce.options(num_returns=2).remote(
             reduce_blob, *parts
         )
-        pending.append((block_ref, meta_ref))
-    for block_ref, meta_ref in pending:
-        meta = ray_tpu.get(meta_ref, timeout=600)
-        out.append((block_ref, meta))
+        pending[meta_ref] = (j, block_ref)
+    # drain reducers in completion order; the 600s window is a
+    # NO-PROGRESS timeout (it resets whenever any reducer finishes), so
+    # long serial makespans on small clusters still complete
+    while pending:
+        ready, _ = ray_tpu.wait(list(pending.keys()), num_returns=1,
+                                timeout=600)
+        if not ready:
+            raise TimeoutError(
+                "all-to-all made no progress for 600s "
+                f"({len(pending)} reducers outstanding)")
+        for meta_ref in ready:
+            j, block_ref = pending.pop(meta_ref)
+            out[j] = (block_ref, ray_tpu.get(meta_ref, timeout=600))
     if keep_empty:
         # repartition(n)/split(n) promise exactly n output blocks even when
         # some are empty

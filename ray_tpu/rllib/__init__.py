@@ -10,6 +10,10 @@ from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms import (
     APPO,
     APPOConfig,
+    DDPG,
+    DDPGConfig,
+    TD3,
+    TD3Config,
     DQN,
     DQNConfig,
     IMPALA,
@@ -36,6 +40,7 @@ from ray_tpu.rllib.env import (
     CartPole,
     Corridor,
     Env,
+    Pendulum,
     GymEnv,
     VectorEnv,
     make_env,
@@ -64,6 +69,11 @@ __all__ = [
     "MultiAgentPPO",
     "MultiAgentPPOConfig",
     "NormalizeObs",
+    "Pendulum",
+    "DDPG",
+    "DDPGConfig",
+    "TD3",
+    "TD3Config",
     "Corridor",
     "DQN",
     "DQNConfig",

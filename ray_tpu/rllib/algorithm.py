@@ -151,6 +151,9 @@ class Algorithm:
             info = self._local_runner.env_info()
         self.obs_dim = info["observation_dim"]
         self.num_actions = info["num_actions"]
+        self.continuous = info.get("continuous", False)
+        self.action_dim = info.get("action_dim", 0)
+        self.action_bound = info.get("action_bound", 1.0)
         self._build_learner()
 
     def _runner_factory(self):

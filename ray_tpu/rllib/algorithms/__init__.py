@@ -14,3 +14,7 @@ __all__ += ["IMPALA", "ImpalaConfig"]
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 
 __all__ += ["APPO", "APPOConfig"]
+
+from ray_tpu.rllib.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
+
+__all__ += ["DDPG", "DDPGConfig", "TD3", "TD3Config"]

@@ -29,10 +29,22 @@ def dqn_loss(module, params, batch, config):
     best = jnp.argmax(q_next_online, axis=-1)
     q_next = jnp.take_along_axis(q_next_target, best[:, None], axis=-1)[:, 0]
     not_term = 1.0 - batch["terminateds"].astype(q.dtype)
-    target = batch["rewards"] + config["gamma"] * not_term * q_next
+    # per-sample bootstrap discount gamma**k: n-step windows truncated at
+    # episode/rollout boundaries carry k < n_step
+    target = batch["rewards"] + batch["discounts"] * not_term * q_next
     td = q_taken - jax.lax.stop_gradient(target)
-    loss = jnp.mean(jnp.square(td))
-    return loss, {"q_mean": jnp.mean(q_taken), "td_abs": jnp.mean(jnp.abs(td))}
+    weights = batch.get("weights")  # PER importance-sampling weights
+    if weights is None:
+        loss = jnp.mean(jnp.square(td))
+    else:
+        loss = jnp.mean(weights * jnp.square(td))
+    return loss, {
+        "q_mean": jnp.mean(q_taken),
+        "td_abs": jnp.mean(jnp.abs(td)),
+        # per-sample magnitudes for PER priority refresh (underscore
+        # prefix: Learner returns these as arrays, not scalar metrics)
+        "_td_abs": jnp.abs(td),
+    }
 
 
 class DQNConfig(AlgorithmConfig):
@@ -46,6 +58,13 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_end = 0.05
         self.epsilon_decay_steps = 5_000
         self.lr = 1e-3
+        # rainbow-style extensions (each independently toggleable;
+        # reference: dqn.py config dueling/n_step/prioritized_replay)
+        self.dueling = False
+        self.n_step = 1
+        self.prioritized_replay = False
+        self.per_alpha = 0.6
+        self.per_beta = 0.4
         self.algo_class = DQN
 
 
@@ -54,24 +73,85 @@ class DQN(Algorithm):
 
     def _runner_factory(self):
         hidden = tuple(self.config.hidden)
-        return lambda obs_dim, n_act: QModule(obs_dim, n_act, hidden)
+        dueling = self.config.dueling
+        return lambda obs_dim, n_act: QModule(obs_dim, n_act, hidden,
+                                              dueling=dueling)
 
     def _build_learner(self) -> None:
         cfg = self.config
-        module = QModule(self.obs_dim, self.num_actions, cfg.hidden)
+        module = QModule(self.obs_dim, self.num_actions, cfg.hidden,
+                         dueling=cfg.dueling)
         self.learner = Learner(
             module,
             dqn_loss,
-            config={"gamma": cfg.gamma},
+            config={"gamma": cfg.gamma},  # discounts ride per-sample in batch
             learning_rate=cfg.lr,
             max_grad_norm=cfg.max_grad_norm,
             mesh=cfg.mesh,
             seed=cfg.seed,
         )
-        self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim, seed=cfg.seed)
+        if cfg.prioritized_replay:
+            from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
+
+            self.buffer = PrioritizedReplayBuffer(
+                cfg.buffer_capacity, self.obs_dim, seed=cfg.seed,
+                alpha=cfg.per_alpha, beta=cfg.per_beta,
+            )
+        else:
+            self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim,
+                                       seed=cfg.seed)
         self._target_params = self.learner.get_weights_np()
         self._grad_steps = 0
         self._broadcast_weights(self.learner.get_weights_np(), self._epsilon())
+
+    def _nstep(self, b: dict) -> tuple:
+        """Collapse a [T, E] rollout into n-step transitions: returns
+        (obs_t, a_t, sum_{k<n} gamma^k r_{t+k}, next_obs_{t+n}, term) with
+        the lookahead truncated at episode boundaries (reference:
+        rllib/utils/replay_buffers n-step postprocessing)."""
+        cfg = self.config
+        n = cfg.n_step
+        T, E = b["rewards"].shape
+        if n <= 1:
+            return (
+                b["obs"].reshape(T * E, -1),
+                b["actions"].reshape(-1),
+                b["rewards"].reshape(-1),
+                b["next_obs"].reshape(T * E, -1),
+                b["terminateds"].reshape(-1),
+                np.full(T * E, cfg.gamma, np.float32),
+            )
+        obs, actions, rewards, next_obs, term, disc = [], [], [], [], [], []
+        for t in range(T):
+            ret = np.zeros(E, np.float32)
+            done_mask = np.zeros(E, np.bool_)
+            term_mask = np.zeros(E, np.bool_)
+            last = np.full(E, t, np.int64)
+            for k in range(n):
+                tk = t + k
+                if tk >= T:
+                    break
+                ret = ret + np.where(done_mask, 0.0,
+                                     cfg.gamma ** k * b["rewards"][tk])
+                last = np.where(done_mask, last, tk)
+                term_mask = term_mask | (~done_mask & b["terminateds"][tk])
+                done_mask = done_mask | b["dones"][tk]
+            obs.append(b["obs"][t])
+            actions.append(b["actions"][t])
+            rewards.append(ret)
+            next_obs.append(b["next_obs"][last, np.arange(E)])
+            term.append(term_mask)
+            # bootstrap discount matches the ACTUAL window: gamma**steps,
+            # where steps = last_included - t + 1 (< n at boundaries)
+            disc.append((cfg.gamma ** (last - t + 1)).astype(np.float32))
+        return (
+            np.concatenate(obs),
+            np.concatenate(actions),
+            np.concatenate(rewards),
+            np.concatenate(next_obs),
+            np.concatenate(term),
+            np.concatenate(disc),
+        )
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -81,25 +161,24 @@ class DQN(Algorithm):
     def training_step(self) -> dict:
         cfg = self.config
         for b in self._sample_all():
-            T, E = b["rewards"].shape
-            self.buffer.add_batch(
-                b["obs"].reshape(T * E, -1),
-                b["actions"].reshape(-1),
-                b["rewards"].reshape(-1),
-                b["next_obs"].reshape(T * E, -1),
-                b["terminateds"].reshape(-1),
-            )
+            self.buffer.add_batch(*self._nstep(b))
         metrics_acc: dict[str, list[float]] = {}
         if len(self.buffer) >= cfg.learning_starts:
             for _ in range(cfg.updates_per_iteration):
                 mb = self.buffer.sample(cfg.minibatch_size)
+                indices = mb.pop("indices", None)
                 mb["target_params"] = self._target_params
                 m = self.learner.update(mb)
+                td_abs = m.pop("_td_abs", None)
                 self._grad_steps += 1
                 if self._grad_steps % cfg.target_update_freq == 0:
                     self._target_params = self.learner.get_weights_np()
                 for k, v in m.items():
                     metrics_acc.setdefault(k, []).append(v)
+                if indices is not None and td_abs is not None:
+                    # priorities refresh straight from the jitted update's
+                    # per-sample |td| — no host-side recompute
+                    self.buffer.update_priorities(indices, td_abs)
         self._broadcast_weights(self.learner.get_weights_np(), self._epsilon())
         out = {k: float(np.mean(v)) for k, v in metrics_acc.items()}
         out["epsilon"] = self._epsilon()

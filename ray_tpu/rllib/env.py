@@ -18,16 +18,22 @@ class Env:
     """Single-agent episodic env protocol (gymnasium-shaped).
 
     reset(seed) -> obs ; step(action) -> (obs, reward, terminated, truncated).
+    Discrete envs take an int action (num_actions); continuous envs set
+    `continuous = True` and take a float array of `action_dim` values in
+    [-action_bound, action_bound].
     """
 
     observation_dim: int
-    num_actions: int
+    num_actions: int = 0
     max_episode_steps: int = 1000
+    continuous: bool = False
+    action_dim: int = 0
+    action_bound: float = 1.0
 
     def reset(self, seed: int | None = None) -> np.ndarray:
         raise NotImplementedError
 
-    def step(self, action: int):
+    def step(self, action):
         raise NotImplementedError
 
 
@@ -111,6 +117,61 @@ class Corridor(Env):
         return np.array([self._pos], np.float32), reward, done, truncated
 
 
+class Pendulum(Env):
+    """Classic underactuated pendulum swing-up (standard dynamics; the
+    reference's tuned continuous-control examples use gymnasium's
+    Pendulum-v1). obs = [cos th, sin th, th_dot]; reward penalizes angle,
+    velocity, and torque; episodes truncate at 200 steps."""
+
+    observation_dim = 3
+    continuous = True
+    action_dim = 1
+    action_bound = 2.0
+    max_episode_steps = 200
+
+    G = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+
+    def __init__(self):
+        self._rng = np.random.default_rng(0)
+        self._th = 0.0
+        self._th_dot = 0.0
+        self._steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array(
+            [np.cos(self._th), np.sin(self._th), self._th_dot], np.float32
+        )
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._th_dot = self._rng.uniform(-1.0, 1.0)
+        self._steps = 0
+        return self._obs()
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.action_bound, self.action_bound))
+        th, th_dot = self._th, self._th_dot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th**2 + 0.1 * th_dot**2 + 0.001 * u**2
+        th_dot = th_dot + (
+            3 * self.G / (2 * self.LENGTH) * np.sin(th)
+            + 3.0 / (self.MASS * self.LENGTH**2) * u
+        ) * self.DT
+        th_dot = float(np.clip(th_dot, -self.MAX_SPEED, self.MAX_SPEED))
+        th = th + th_dot * self.DT
+        self._th, self._th_dot = th, th_dot
+        self._steps += 1
+        truncated = self._steps >= self.max_episode_steps
+        return self._obs(), -cost, False, truncated
+
+
 class GymEnv(Env):
     """Adapter for a gymnasium env (discrete action space)."""
 
@@ -138,7 +199,11 @@ class GymEnv(Env):
         )
 
 
-_REGISTRY: dict[str, type] = {"CartPole-v1": CartPole, "Corridor": Corridor}
+_REGISTRY: dict[str, type] = {
+    "CartPole-v1": CartPole,
+    "Corridor": Corridor,
+    "Pendulum-v1": Pendulum,
+}
 
 
 def register_env(name: str, creator) -> None:
@@ -167,6 +232,9 @@ class VectorEnv:
         self.num_envs = num_envs
         self.observation_dim = self.envs[0].observation_dim
         self.num_actions = self.envs[0].num_actions
+        self.continuous = self.envs[0].continuous
+        self.action_dim = self.envs[0].action_dim
+        self.action_bound = self.envs[0].action_bound
         self._episode_return = np.zeros(num_envs, np.float64)
         self._episode_len = np.zeros(num_envs, np.int64)
         self.completed_returns: list[float] = []
@@ -187,7 +255,8 @@ class VectorEnv:
         truncation boundaries bootstrap from the real successor state."""
         true_next, cur_obs, rewards, dones, terms = [], [], [], [], []
         for i, (env, a) in enumerate(zip(self.envs, actions)):
-            obs, r, terminated, truncated = env.step(int(a))
+            obs, r, terminated, truncated = env.step(
+                a if self.continuous else int(a))
             self._episode_return[i] += r
             self._episode_len[i] += 1
             done = terminated or truncated

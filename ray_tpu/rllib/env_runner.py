@@ -26,6 +26,8 @@ class EnvRunner:
         # actor_critic: sample policy + record logp/values (PPO family)
         # epsilon_greedy: argmax Q with annealed exploration (DQN family)
         # softmax: sample the module's stochastic policy (SAC family)
+        # continuous: deterministic policy + gaussian exploration noise
+        #             scaled by `epsilon` (TD3/DDPG family)
         mode: str = "actor_critic",
         connectors: list | None = None,
     ):
@@ -53,6 +55,9 @@ class EnvRunner:
         return {
             "observation_dim": self.obs_dim,
             "num_actions": self.vec.num_actions,
+            "continuous": self.vec.continuous,
+            "action_dim": self.vec.action_dim,
+            "action_bound": self.vec.action_bound,
         }
 
     def get_state(self) -> dict:
@@ -70,7 +75,11 @@ class EnvRunner:
         obs_dim = self.obs_dim
         batch = {
             "obs": np.empty((T, E, obs_dim), np.float32),
-            "actions": np.empty((T, E), np.int32),
+            "actions": (
+                np.empty((T, E, self.vec.action_dim), np.float32)
+                if self.mode == "continuous"
+                else np.empty((T, E), np.int32)
+            ),
             "rewards": np.empty((T, E), np.float32),
             "dones": np.empty((T, E), np.bool_),
             "terminateds": np.empty((T, E), np.bool_),
@@ -97,6 +106,15 @@ class EnvRunner:
                 actions = self.module.sample_actions_np(
                     self._params, obs, self._rng
                 )
+            elif self.mode == "continuous":
+                mean = self.module.policy_np(self._params, obs)
+                noise = self._rng.normal(
+                    0.0, self.epsilon * self.vec.action_bound, mean.shape
+                )
+                actions = np.clip(
+                    mean + noise,
+                    -self.vec.action_bound, self.vec.action_bound,
+                ).astype(np.float32)
             else:
                 q = self.module.forward_np(self._params, obs)
                 greedy = np.argmax(q, axis=-1)

@@ -95,7 +95,12 @@ class Learner:
         self.params, self.opt_state, metrics = self._update_jit(
             self.params, self.opt_state, batch
         )
-        return {k: float(v) for k, v in metrics.items()}
+        # "_"-prefixed metrics are per-sample arrays (e.g. PER |td|);
+        # everything else reduces to a float scalar
+        return {
+            k: (np.asarray(v) if k.startswith("_") else float(v))
+            for k, v in metrics.items()
+        }
 
     def get_weights_np(self) -> dict:
         """Host numpy copy for EnvRunner broadcast (device→host once per

@@ -1,9 +1,17 @@
-"""Uniform ring replay buffer for off-policy algorithms.
+"""Replay buffers for off-policy algorithms.
 
 Equivalent of the reference's replay buffers
 (reference: rllib/utils/replay_buffers/replay_buffer.py uniform storage;
-prioritized variant not yet ported). Stores flat transition arrays; samples
-fixed-size minibatches (static shapes for the jitted learner).
+prioritized_replay_buffer.py proportional PER per Schaul et al. 2016).
+Stores flat transition arrays; samples fixed-size minibatches (static
+shapes for the jitted learner). Discrete actions are int32 scalars;
+continuous actions are float32 [action_dim] vectors (action_dim=None
+selects discrete storage).
+
+The prioritized variant uses numpy cumulative sums over the priority
+array instead of the reference's segment tree — O(n) per sampled batch,
+which at the 1e5-transition scale these buffers run at is a few hundred
+microseconds and keeps the implementation 40 lines instead of 200.
 """
 from __future__ import annotations
 
@@ -11,21 +19,32 @@ import numpy as np
 
 
 class ReplayBuffer:
-    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
+                 action_dim: int | None = None):
         self.capacity = capacity
         self._rng = np.random.default_rng(seed)
         self._obs = np.empty((capacity, obs_dim), np.float32)
-        self._actions = np.empty(capacity, np.int32)
+        if action_dim is None:
+            self._actions = np.empty(capacity, np.int32)
+        else:
+            self._actions = np.empty((capacity, action_dim), np.float32)
         self._rewards = np.empty(capacity, np.float32)
         self._next_obs = np.empty((capacity, obs_dim), np.float32)
         self._terminated = np.empty(capacity, np.bool_)
+        # bootstrap discount per transition: gamma**k where k is the
+        # ACTUAL lookahead (n-step windows truncate at episode/rollout
+        # boundaries, so k varies per sample)
+        self._discounts = np.empty(capacity, np.float32)
         self._size = 0
         self._head = 0
 
     def __len__(self) -> int:
         return self._size
 
-    def add_batch(self, obs, actions, rewards, next_obs, terminated) -> None:
+    def add_batch(self, obs, actions, rewards, next_obs, terminated,
+                  discounts=None):
+        """Returns the storage indices written (PER subclass re-uses them
+        to seed priorities)."""
         n = len(actions)
         idx = (self._head + np.arange(n)) % self.capacity
         self._obs[idx] = obs
@@ -33,15 +52,62 @@ class ReplayBuffer:
         self._rewards[idx] = rewards
         self._next_obs[idx] = next_obs
         self._terminated[idx] = terminated
+        self._discounts[idx] = 1.0 if discounts is None else discounts
         self._head = int((self._head + n) % self.capacity)
         self._size = int(min(self._size + n, self.capacity))
+        return idx
 
-    def sample(self, batch_size: int) -> dict:
-        idx = self._rng.integers(0, self._size, size=batch_size)
+    def _rows(self, idx: np.ndarray) -> dict:
         return {
             "obs": self._obs[idx],
             "actions": self._actions[idx],
             "rewards": self._rewards[idx],
             "next_obs": self._next_obs[idx],
             "terminateds": self._terminated[idx],
+            "discounts": self._discounts[idx],
         }
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return self._rows(idx)
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized experience replay (reference:
+    prioritized_replay_buffer.py): P(i) ∝ p_i^alpha, importance-sampling
+    weights w_i = (N * P(i))^-beta normalized by max, priorities updated
+    to |td| after each learn step."""
+
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
+                 action_dim: int | None = None, alpha: float = 0.6,
+                 beta: float = 0.4, eps: float = 1e-6):
+        super().__init__(capacity, obs_dim, seed=seed, action_dim=action_dim)
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+        self._priorities = np.zeros(capacity, np.float64)
+        self._max_priority = 1.0
+
+    def add_batch(self, obs, actions, rewards, next_obs, terminated,
+                  discounts=None):
+        idx = super().add_batch(obs, actions, rewards, next_obs, terminated,
+                                discounts)
+        # new transitions enter at max priority so they are seen at least
+        # once before their TD error is known
+        self._priorities[idx] = self._max_priority
+        return idx
+
+    def sample(self, batch_size: int) -> dict:
+        p = self._priorities[: self._size] ** self.alpha
+        p = p / p.sum()
+        idx = self._rng.choice(self._size, size=batch_size, p=p)
+        batch = self._rows(idx)
+        weights = (self._size * p[idx]) ** (-self.beta)
+        batch["weights"] = (weights / weights.max()).astype(np.float32)
+        batch["indices"] = idx
+        return batch
+
+    def update_priorities(self, indices: np.ndarray, td_abs: np.ndarray):
+        pr = np.abs(td_abs) + self.eps
+        self._priorities[indices] = pr
+        self._max_priority = max(self._max_priority, float(pr.max()))

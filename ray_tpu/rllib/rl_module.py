@@ -101,25 +101,112 @@ class ActorCriticModule:
 
 
 class QModule:
-    """Q-network MLP for value-based algorithms (DQN family)."""
+    """Q-network MLP for value-based algorithms (DQN family). With
+    `dueling`, the net splits into value + advantage streams recombined as
+    Q = V + A - mean(A) (reference: dqn_torch_model.py dueling heads,
+    Wang et al. 2016)."""
 
-    def __init__(self, obs_dim: int, num_actions: int, hidden: Sequence[int] = (64, 64)):
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64), dueling: bool = False):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
         self.hidden = tuple(hidden)
+        self.dueling = dueling
 
     def init(self, seed: int = 0) -> dict:
         rng = np.random.default_rng(seed)
-        dims = [self.obs_dim, *self.hidden, self.num_actions]
+        if not self.dueling:
+            dims = [self.obs_dim, *self.hidden, self.num_actions]
+            return {
+                "q": [
+                    _init_linear(rng, dims[i], dims[i + 1], np.sqrt(2))
+                    for i in range(len(dims) - 1)
+                ]
+            }
+        dims = [self.obs_dim, *self.hidden]
+        trunk = [
+            _init_linear(rng, dims[i], dims[i + 1], np.sqrt(2))
+            for i in range(len(dims) - 1)
+        ]
         return {
-            "q": [
-                _init_linear(rng, dims[i], dims[i + 1], np.sqrt(2))
-                for i in range(len(dims) - 1)
-            ]
+            "trunk": trunk,
+            "v": [_init_linear(rng, dims[-1], 1, 1.0)],
+            "a": [_init_linear(rng, dims[-1], self.num_actions, 0.01)],
         }
 
     def forward_np(self, params: dict, obs: np.ndarray) -> np.ndarray:
-        return ActorCriticModule._mlp_np(params["q"], obs)
+        if not self.dueling:
+            return ActorCriticModule._mlp_np(params["q"], obs)
+        h = obs
+        for layer in params["trunk"]:
+            h = np.tanh(h @ layer["w"] + layer["b"])
+        v = h @ params["v"][0]["w"] + params["v"][0]["b"]
+        a = h @ params["a"][0]["w"] + params["a"][0]["b"]
+        return v + a - a.mean(axis=-1, keepdims=True)
 
     def forward(self, params, obs):
-        return _mlp_jax(params["q"], obs)
+        import jax.numpy as jnp
+
+        if not self.dueling:
+            return _mlp_jax(params["q"], obs)
+        h = obs
+        for layer in params["trunk"]:
+            h = jnp.tanh(h @ layer["w"] + layer["b"])
+        v = h @ params["v"][0]["w"] + params["v"][0]["b"]
+        a = h @ params["a"][0]["w"] + params["a"][0]["b"]
+        return v + a - jnp.mean(a, axis=-1, keepdims=True)
+
+
+class DeterministicPolicyModule:
+    """Actor-critic pair for continuous control: tanh-bounded deterministic
+    actor pi(s) and twin Q(s, a) critics (reference: rllib's DDPG/TD3
+    models — ddpg/ddpg_torch_model.py actor + twin critics per TD3,
+    Fujimoto et al. 2018)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, action_bound: float,
+                 hidden: Sequence[int] = (64, 64), twin_q: bool = True):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.action_bound = float(action_bound)
+        self.hidden = tuple(hidden)
+        self.twin_q = twin_q
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        params: dict = {}
+        dims_pi = [self.obs_dim, *self.hidden]
+        layers = [
+            _init_linear(rng, dims_pi[i], dims_pi[i + 1], np.sqrt(2))
+            for i in range(len(dims_pi) - 1)
+        ]
+        layers.append(_init_linear(rng, dims_pi[-1], self.action_dim, 0.01))
+        params["pi"] = layers
+        heads = ("q1", "q2") if self.twin_q else ("q1",)
+        for head in heads:
+            dims_q = [self.obs_dim + self.action_dim, *self.hidden]
+            layers = [
+                _init_linear(rng, dims_q[i], dims_q[i + 1], np.sqrt(2))
+                for i in range(len(dims_q) - 1)
+            ]
+            layers.append(_init_linear(rng, dims_q[-1], 1, 1.0))
+            params[head] = layers
+        return params
+
+    # -- numpy path (EnvRunner action selection) --
+
+    def policy_np(self, params: dict, obs: np.ndarray) -> np.ndarray:
+        raw = ActorCriticModule._mlp_np(params["pi"], obs)
+        return np.tanh(raw) * self.action_bound
+
+    # -- jax path (Learner) --
+
+    def policy(self, params, obs):
+        import jax.numpy as jnp
+
+        return jnp.tanh(_mlp_jax(params["pi"], obs)) * self.action_bound
+
+    def q_value(self, params, obs, actions, head: str = "q1"):
+        import jax.numpy as jnp
+
+        x = jnp.concatenate([obs, actions], axis=-1)
+        return _mlp_jax(params[head], x)[:, 0]
