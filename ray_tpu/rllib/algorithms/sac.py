@@ -1,10 +1,12 @@
-"""SAC — soft actor-critic (discrete-action variant).
+"""SAC — soft actor-critic, continuous and discrete.
 
 Equivalent of the reference's SAC (reference: rllib/algorithms/sac/sac.py,
-losses in sac/sac_torch_policy.py; discrete support per the public
+losses in sac/sac_torch_policy.py — canonical continuous squashed-Gaussian
+form per Haarnoja et al. 2018, plus discrete support per the public
 SAC-Discrete formulation). Off-policy: replay buffer, twin soft Q networks
-with polyak targets, entropy-regularized policy, optional automatic
-temperature tuning toward a target entropy.
+with polyak targets, entropy-regularized policy, automatic temperature
+tuning toward a target entropy. The env's action space selects the variant
+at build time.
 
 One Learner/optimizer over {pi, q1, q2, log_alpha}: the loss terms isolate
 their gradients with stop_gradient, so a single optax chain updates all
@@ -17,7 +19,12 @@ import numpy as np
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.learner import Learner
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
-from ray_tpu.rllib.rl_module import ActorCriticModule, QModule, _mlp_jax
+from ray_tpu.rllib.rl_module import (
+    ActorCriticModule,
+    DeterministicPolicyModule,
+    QModule,
+    _mlp_jax,
+)
 
 
 class SACModule:
@@ -113,6 +120,137 @@ def sac_loss(module, params, batch, config):
     }
 
 
+class ContinuousSACModule:
+    """Squashed-Gaussian policy + twin Q(s, a) (reference: SAC's canonical
+    continuous form, sac_torch_model.py — Haarnoja et al. 2018; the
+    discrete SACModule above is the derived variant)."""
+
+    LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
+
+    def __init__(self, obs_dim: int, action_dim: int, action_bound: float,
+                 hidden=(64, 64)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.action_bound = float(action_bound)
+        self.hidden = tuple(hidden)
+        self._det = DeterministicPolicyModule(
+            obs_dim, action_dim, action_bound, hidden, twin_q=True
+        )
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        from ray_tpu.rllib.rl_module import _init_linear
+
+        dims = [self.obs_dim, *self.hidden]
+        layers = [
+            _init_linear(rng, dims[i], dims[i + 1], np.sqrt(2))
+            for i in range(len(dims) - 1)
+        ]
+        # one head emitting [mu, log_std]
+        layers.append(_init_linear(rng, dims[-1], 2 * self.action_dim, 0.01))
+        base = self._det.init(seed + 1)
+        return {
+            "pi": layers,
+            "q1": base["q1"],
+            "q2": base["q2"],
+            "log_alpha": np.float32(np.log(0.1)),
+        }
+
+    def _dist_np(self, params, obs):
+        out = ActorCriticModule._mlp_np(params["pi"], obs)
+        mu, log_std = np.split(out, 2, axis=-1)
+        log_std = np.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mu, log_std
+
+    def sample_actions_np(self, params, obs, rng):
+        mu, log_std = self._dist_np(params, obs)
+        eps = rng.standard_normal(mu.shape)
+        return np.tanh(mu + np.exp(log_std) * eps) * self.action_bound
+
+    # -- jax path --
+
+    def dist(self, params, obs):
+        import jax.numpy as jnp
+
+        out = _mlp_jax(params["pi"], obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        return mu, jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+
+    def sample_and_logp(self, params, obs, key):
+        """Reparameterized squashed-Gaussian sample + its log-prob (with
+        the tanh change-of-variables correction)."""
+        import jax
+        import jax.numpy as jnp
+
+        mu, log_std = self.dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mu.shape)
+        pre = mu + std * eps
+        logp_gauss = jnp.sum(
+            -0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi)), axis=-1
+        )
+        tanh_pre = jnp.tanh(pre)
+        # d tanh correction (numerically stable form)
+        logp = logp_gauss - jnp.sum(
+            2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)), axis=-1
+        )
+        return tanh_pre * self.action_bound, logp
+
+    def q_value(self, params, obs, actions, head: str = "q1"):
+        return self._det.q_value(params, obs, actions, head)
+
+
+def sac_continuous_loss(module, params, batch, config):
+    """Twin-Q soft TD + reparameterized policy + temperature (pure jax;
+    sampling keys ride the batch so the jitted signature stays fixed)."""
+    import jax
+    import jax.numpy as jnp
+
+    alpha = jnp.exp(params["log_alpha"])
+    gamma = config["gamma"]
+    k1, k2 = jax.random.split(batch["rng"]["key"])
+
+    a_next, logp_next = module.sample_and_logp(params, batch["next_obs"], k1)
+    tgt = {"q1": batch["target_q1"], "q2": batch["target_q2"]}
+    q_t = jnp.minimum(
+        module.q_value(tgt, batch["next_obs"], a_next, "q1"),
+        module.q_value(tgt, batch["next_obs"], a_next, "q2"),
+    )
+    not_term = 1.0 - batch["terminateds"].astype(jnp.float32)
+    target = jax.lax.stop_gradient(
+        batch["rewards"]
+        + gamma * not_term * (q_t - jax.lax.stop_gradient(alpha) * logp_next)
+    )
+    q1 = module.q_value(params, batch["obs"], batch["actions"], "q1")
+    q2 = module.q_value(params, batch["obs"], batch["actions"], "q2")
+    q_loss = jnp.mean(jnp.square(q1 - target)) + jnp.mean(jnp.square(q2 - target))
+
+    # policy: gradients flow through the ACTION into frozen-critic weights
+    a_new, logp_new = module.sample_and_logp(params, batch["obs"], k2)
+    frozen = {
+        "q1": jax.lax.stop_gradient(params["q1"]),
+        "q2": jax.lax.stop_gradient(params["q2"]),
+    }
+    q_pi = jnp.minimum(
+        module.q_value(frozen, batch["obs"], a_new, "q1"),
+        module.q_value(frozen, batch["obs"], a_new, "q2"),
+    )
+    pi_loss = jnp.mean(jax.lax.stop_gradient(alpha) * logp_new - q_pi)
+
+    # temperature toward target entropy = -action_dim (standard heuristic)
+    alpha_loss = -jnp.mean(
+        params["log_alpha"]
+        * jax.lax.stop_gradient(logp_new + config["target_entropy"])
+    )
+    total = q_loss + pi_loss + config["alpha_lr_scale"] * alpha_loss
+    return total, {
+        "q_loss": q_loss,
+        "pi_loss": pi_loss,
+        "alpha": alpha,
+        "entropy_mean": -jnp.mean(logp_new),
+    }
+
+
 class SACConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()
@@ -129,22 +267,51 @@ class SACConfig(AlgorithmConfig):
 class SAC(Algorithm):
     runner_mode = "softmax"  # stochastic policy is the exploration
 
+    def _setup(self) -> None:
+        # action space selects the variant BEFORE runners are built
+        from ray_tpu.rllib.env import make_env
+
+        probe = make_env(self.config.env_spec)
+        self._continuous = probe.continuous
+        if self._continuous:
+            self.runner_mode = "continuous"
+            self._probe_action_dim = probe.action_dim
+            self._probe_action_bound = probe.action_bound
+        probe.close()
+        super()._setup()
+
     def _runner_factory(self):
         hidden = tuple(self.config.hidden)
+        if self._continuous:
+            action_dim = self._probe_action_dim
+            bound = self._probe_action_bound
+            return lambda obs_dim, n_act: ContinuousSACModule(
+                obs_dim, action_dim, bound, hidden)
         return lambda obs_dim, n_act: SACModule(obs_dim, n_act, hidden)
 
     def _build_learner(self) -> None:
         cfg = self.config
         import math
 
-        module = SACModule(self.obs_dim, self.num_actions, cfg.hidden)
+        if self._continuous:
+            module = ContinuousSACModule(
+                self.obs_dim, self.action_dim, self.action_bound, cfg.hidden
+            )
+            loss = sac_continuous_loss
+            target_entropy = -float(self.action_dim)
+            action_dim = self.action_dim
+        else:
+            module = SACModule(self.obs_dim, self.num_actions, cfg.hidden)
+            loss = sac_loss
+            target_entropy = cfg.target_entropy_scale * math.log(
+                self.num_actions)
+            action_dim = None
         self.learner = Learner(
             module,
-            sac_loss,
+            loss,
             config={
                 "gamma": cfg.gamma,
-                "target_entropy": cfg.target_entropy_scale
-                * math.log(self.num_actions),
+                "target_entropy": target_entropy,
                 "alpha_lr_scale": cfg.alpha_lr_scale,
             },
             learning_rate=cfg.lr,
@@ -152,7 +319,9 @@ class SAC(Algorithm):
             mesh=cfg.mesh,
             seed=cfg.seed,
         )
-        self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim, seed=cfg.seed)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, self.obs_dim,
+                                   seed=cfg.seed, action_dim=action_dim)
+        self._rng_step = 0
         w = self.learner.get_weights_np()
         self._target_q1 = w["q1"]
         self._target_q2 = w["q2"]
@@ -176,17 +345,28 @@ class SAC(Algorithm):
             T, E = b["rewards"].shape
             self.buffer.add_batch(
                 b["obs"].reshape(T * E, -1),
-                b["actions"].reshape(-1),
+                (b["actions"].reshape(T * E, -1) if self._continuous
+                 else b["actions"].reshape(-1)),
                 b["rewards"].reshape(-1),
                 b["next_obs"].reshape(T * E, -1),
                 b["terminateds"].reshape(-1),
             )
         metrics_acc: dict[str, list[float]] = {}
         if len(self.buffer) >= cfg.learning_starts:
+            import jax
+
             for _ in range(cfg.updates_per_iteration):
                 mb = self.buffer.sample(cfg.minibatch_size)
                 mb["target_q1"] = self._target_q1
                 mb["target_q2"] = self._target_q2
+                if self._continuous:
+                    # fresh sampling key each update, riding the batch so
+                    # the jitted loss signature stays fixed. Nested in a
+                    # dict: Learner's mesh path data-shards TOP-LEVEL
+                    # ndarrays, and a shape-(2,) key must replicate
+                    self._rng_step += 1
+                    mb["rng"] = {"key": np.asarray(
+                        jax.random.PRNGKey(cfg.seed * 100003 + self._rng_step))}
                 m = self.learner.update(mb)
                 self._polyak()
                 for k, v in m.items():

@@ -194,8 +194,10 @@ class TD3(Algorithm):
 
         probe = make_env(self.config.env_spec)
         if not probe.continuous:
+            probe.close()
             raise ValueError("TD3/DDPG require a continuous-action env")
         action_dim, action_bound = probe.action_dim, probe.action_bound
+        probe.close()
         hidden = tuple(self.config.hidden)
         twin = self.config.twin_q
 

@@ -36,6 +36,9 @@ class Env:
     def step(self, action):
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release simulator resources (no-op for the built-ins)."""
+
 
 class CartPole(Env):
     """Classic cart-pole balancing (standard physics; reference uses
@@ -197,6 +200,9 @@ class GymEnv(Env):
             bool(terminated),
             bool(truncated),
         )
+
+    def close(self) -> None:
+        self._env.close()
 
 
 _REGISTRY: dict[str, type] = {

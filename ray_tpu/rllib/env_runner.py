@@ -107,14 +107,20 @@ class EnvRunner:
                     self._params, obs, self._rng
                 )
             elif self.mode == "continuous":
-                mean = self.module.policy_np(self._params, obs)
-                noise = self._rng.normal(
-                    0.0, self.epsilon * self.vec.action_bound, mean.shape
-                )
-                actions = np.clip(
-                    mean + noise,
-                    -self.vec.action_bound, self.vec.action_bound,
-                ).astype(np.float32)
+                if hasattr(self.module, "sample_actions_np"):
+                    # stochastic policy (SAC): its own sampling explores
+                    actions = self.module.sample_actions_np(
+                        self._params, obs, self._rng
+                    ).astype(np.float32)
+                else:
+                    mean = self.module.policy_np(self._params, obs)
+                    noise = self._rng.normal(
+                        0.0, self.epsilon * self.vec.action_bound, mean.shape
+                    )
+                    actions = np.clip(
+                        mean + noise,
+                        -self.vec.action_bound, self.vec.action_bound,
+                    ).astype(np.float32)
             else:
                 q = self.module.forward_np(self._params, obs)
                 greedy = np.argmax(q, axis=-1)
