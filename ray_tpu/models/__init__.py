@@ -7,11 +7,20 @@ from ray_tpu.models.llama import (
     llama_param_axes,
 )
 from ray_tpu.models.resnet import ResNet50, resnet_init
+from ray_tpu.models.vit import (
+    ViTConfig,
+    vit_forward,
+    vit_init,
+    vit_loss,
+    vit_num_params,
+    vit_param_axes,
+)
 
 __all__ = [
     "GPTConfig",
     "LlamaConfig",
     "ResNet50",
+    "ViTConfig",
     "gpt_forward",
     "gpt_init",
     "gpt_param_axes",
@@ -20,4 +29,9 @@ __all__ = [
     "llama_loss",
     "llama_param_axes",
     "resnet_init",
+    "vit_forward",
+    "vit_init",
+    "vit_loss",
+    "vit_num_params",
+    "vit_param_axes",
 ]

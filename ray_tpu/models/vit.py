@@ -1,0 +1,221 @@
+"""ViT — vision transformer, mesh-parallel like the GPT family.
+
+Model family matching the reference's vision-transformer workloads (the
+reference trains ViT via TorchTrainer in its AIR examples,
+doc/source/train/examples — the model itself is torchvision's ViT;
+Dosovitskiy et al. 2020). Same construction discipline as models/gpt.py:
+pure-JAX param pytrees with a parallel tree of logical axis names, blocks
+stacked on a leading layer axis and iterated with lax.scan, params f32 /
+activations bf16, flash attention (non-causal) on the hot path.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.layers import gelu, layer_norm
+from ray_tpu.parallel.sharding import ShardingRules, with_logical_constraint
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_mlp: int = 3072
+    channels: int = 3
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"  # flash | xla
+    remat: bool = False
+
+    @staticmethod
+    def base16() -> "ViTConfig":
+        return ViTConfig()  # ViT-B/16
+
+    @staticmethod
+    def tiny(image_size: int = 32, num_classes: int = 16) -> "ViTConfig":
+        """Test-size config for CPU meshes. num_classes defaults to a
+        tp-divisible 16 (the head is class-sharded under tensor
+        parallelism, like GPT's padded vocab)."""
+        return ViTConfig(
+            image_size=image_size, patch_size=8, num_classes=num_classes,
+            n_layer=2, n_head=4, d_model=64, d_mlp=256,
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+
+def vit_init(key: jax.Array, cfg: ViTConfig) -> dict:
+    k = iter(jax.random.split(key, 16))
+    std = 0.02
+    resid_std = std / math.sqrt(2 * cfg.n_layer)
+    L, D, M = cfg.n_layer, cfg.d_model, cfg.d_mlp
+
+    def norm(key, *shape, scale=std):
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    return {
+        "patch_w": norm(next(k), cfg.patch_dim, D),
+        "patch_b": jnp.zeros((D,), jnp.float32),
+        "cls": norm(next(k), 1, 1, D),
+        "pos": norm(next(k), cfg.num_patches + 1, D, scale=std / 2),
+        "blocks": {
+            "ln1_scale": jnp.ones((L, D), jnp.float32),
+            "ln1_bias": jnp.zeros((L, D), jnp.float32),
+            "qkv_w": norm(next(k), L, D, 3 * D),
+            "qkv_b": jnp.zeros((L, 3 * D), jnp.float32),
+            "proj_w": norm(next(k), L, D, D, scale=resid_std),
+            "proj_b": jnp.zeros((L, D), jnp.float32),
+            "ln2_scale": jnp.ones((L, D), jnp.float32),
+            "ln2_bias": jnp.zeros((L, D), jnp.float32),
+            "mlp_in_w": norm(next(k), L, D, M),
+            "mlp_in_b": jnp.zeros((L, M), jnp.float32),
+            "mlp_out_w": norm(next(k), L, M, D, scale=resid_std),
+            "mlp_out_b": jnp.zeros((L, D), jnp.float32),
+        },
+        "ln_f_scale": jnp.ones((D,), jnp.float32),
+        "ln_f_bias": jnp.zeros((D,), jnp.float32),
+        "head_w": norm(next(k), D, cfg.num_classes, scale=0.0),  # zero-init
+        "head_b": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+
+
+def vit_param_axes(cfg: ViTConfig | None = None) -> dict:
+    """Logical axis names (same tree as vit_init) — identical block table
+    to gpt_param_axes so every dp/fsdp/tp rules set applies unchanged."""
+    return {
+        "patch_w": (None, "embed"),
+        "patch_b": ("embed",),
+        "cls": (None, None, "embed"),
+        "pos": (None, "embed"),
+        "blocks": {
+            "ln1_scale": (None, "embed"),
+            "ln1_bias": (None, "embed"),
+            "qkv_w": (None, "embed", "mlp"),
+            "qkv_b": (None, "mlp"),
+            "proj_w": (None, "mlp", "embed"),
+            "proj_b": (None, "embed"),
+            "ln2_scale": (None, "embed"),
+            "ln2_bias": (None, "embed"),
+            "mlp_in_w": (None, "embed", "mlp"),
+            "mlp_in_b": (None, "mlp"),
+            "mlp_out_w": (None, "mlp", "embed"),
+            "mlp_out_b": (None, "embed"),
+        },
+        "ln_f_scale": ("embed",),
+        "ln_f_bias": ("embed",),
+        "head_w": ("embed", "vocab"),
+        "head_b": ("vocab",),
+    }
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] → [B, N, P*P*C] (pure reshape/transpose — XLA fuses
+    this into the embedding matmul; no conv needed)."""
+    B, H, W, C = images.shape
+    P = cfg.patch_size
+    h, w = H // P, W // P
+    x = images.reshape(B, h, P, w, P, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, h * w, P * P * C)
+
+
+def _block(x, bp, cfg: ViTConfig, rules, mesh):
+    B, S, D = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+
+    def constrain(t, axes):
+        if mesh is None:
+            return t
+        return with_logical_constraint(t, axes, rules, mesh)
+
+    h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+    qkv = (h @ bp["qkv_w"].astype(cfg.dtype)) + bp["qkv_b"].astype(cfg.dtype)
+    q, kk, vv = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    kk = kk.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    vv = vv.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    q = constrain(q, ("batch", "heads", None, None))
+
+    if cfg.attention == "flash":
+        attn = flash_attention(q, kk, vv, causal=False)
+    else:
+        attn = mha_reference(q, kk, vv, causal=False)
+
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
+    x = x + (attn @ bp["proj_w"].astype(cfg.dtype)) + bp["proj_b"].astype(cfg.dtype)
+
+    h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    h = gelu((h @ bp["mlp_in_w"].astype(cfg.dtype)) + bp["mlp_in_b"].astype(cfg.dtype))
+    h = constrain(h, ("batch", "seq", "mlp"))
+    x = x + (h @ bp["mlp_out_w"].astype(cfg.dtype)) + bp["mlp_out_b"].astype(cfg.dtype)
+    return constrain(x, ("batch", "seq", "embed"))
+
+
+def vit_forward(
+    params: dict,
+    images: jax.Array,
+    cfg: ViTConfig,
+    *,
+    rules: ShardingRules | None = None,
+    mesh=None,
+) -> jax.Array:
+    """images [B, H, W, C] → class logits [B, num_classes] (f32)."""
+    B = images.shape[0]
+    patches = patchify(images.astype(cfg.dtype), cfg)
+    x = (patches @ params["patch_w"].astype(cfg.dtype)
+         + params["patch_b"].astype(cfg.dtype))
+    cls = jnp.broadcast_to(params["cls"].astype(cfg.dtype), (B, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos"].astype(cfg.dtype)
+    if mesh is not None:
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules, mesh)
+
+    def body(x, bp):
+        return _block(x, bp, cfg, rules, mesh), None
+
+    body_fn = jax.checkpoint(body) if cfg.remat else body
+    x, _ = jax.lax.scan(body_fn, x, params["blocks"])
+
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    cls_repr = x[:, 0].astype(jnp.float32)
+    return cls_repr @ params["head_w"] + params["head_b"]
+
+
+def vit_loss(
+    params: dict,
+    batch: dict,
+    cfg: ViTConfig,
+    *,
+    rules: ShardingRules | None = None,
+    mesh=None,
+):
+    """Cross-entropy + accuracy. batch: {"image" [B,H,W,C], "label" [B]}."""
+    logits = vit_forward(params, batch["image"], cfg, rules=rules, mesh=mesh)
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
+    acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+    return -jnp.mean(ll), acc
+
+
+def vit_num_params(cfg: ViTConfig) -> int:
+    p = vit_init(jax.random.PRNGKey(0), cfg)
+    return sum(x.size for x in jax.tree.leaves(p))
