@@ -33,7 +33,10 @@ class ViTConfig:
     d_mlp: int = 3072
     channels: int = 3
     dtype: Any = jnp.bfloat16
-    attention: str = "flash"  # flash | xla
+    # xla by default: ViT sequences are short (num_patches + 1, ALWAYS odd
+    # because of the cls token) so XLA's fused attention wins; "flash"
+    # engages the Pallas kernel only when the sequence divides its blocks
+    attention: str = "xla"  # xla | flash
     remat: bool = False
 
     @staticmethod
@@ -155,7 +158,11 @@ def _block(x, bp, cfg: ViTConfig, rules, mesh):
     vv = vv.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     q = constrain(q, ("batch", "heads", None, None))
 
-    if cfg.attention == "flash":
+    # the flash kernel needs S divisible by its block size (<=512 clamps
+    # the block to S); an incompatible length falls back to XLA attention
+    S_len = q.shape[2]
+    flash_ok = S_len <= 512 or S_len % 512 == 0
+    if cfg.attention == "flash" and flash_ok:
         attn = flash_attention(q, kk, vv, causal=False)
     else:
         attn = mha_reference(q, kk, vv, causal=False)

@@ -7,6 +7,7 @@ from ray_tpu.train.config import (
 )
 from ray_tpu.train.session import get_context, get_dataset_shard, report
 from ray_tpu.train.trainer import JaxTrainer, Result
+from ray_tpu.train.torch import TorchTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = [
@@ -15,6 +16,7 @@ __all__ = [
     "CheckpointManager",
     "FailureConfig",
     "JaxTrainer",
+    "TorchTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",

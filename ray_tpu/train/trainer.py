@@ -37,6 +37,8 @@ class Result:
 
 
 class JaxTrainer:
+    _backend = "jax"  # distributed-bootstrap flavor (TorchTrainer: "torch")
+
     def __init__(
         self,
         train_loop_per_worker: Callable,
@@ -76,6 +78,7 @@ class JaxTrainer:
             self.scaling_config,
             run_name=self.run_config.name or "train",
             storage_path=storage,
+            backend=self._backend,
         )
         history: list[dict] = []
         latest_metrics: dict = {}

@@ -38,16 +38,27 @@ class TrainWorker:
         return socket.gethostbyname(socket.gethostname())
 
     def setup_distributed(self, coordinator: str, world_size: int, rank: int,
-                          enabled: bool) -> bool:
-        """jax.distributed bootstrap for multi-host gangs (the torch
-        process-group analog, reference train/torch/config.py:63). Opt-in
-        via ScalingConfig.jax_distributed — on a single host every worker
-        is its own JAX process and must NOT contend for the local chip(s)."""
+                          enabled: bool, backend: str = "jax") -> bool:
+        """Distributed bootstrap for the gang. backend="jax": opt-in
+        jax.distributed (via ScalingConfig.jax_distributed — on a single
+        host every worker is its own JAX process and must NOT contend for
+        the local chip(s)). backend="torch": a gloo process group over TCP
+        (the reference's torch rendezvous, train/torch/config.py:63),
+        always initialized — DDP needs it even for world_size 1."""
         import os
 
         os.environ["RT_COORDINATOR"] = coordinator
         os.environ["RT_WORLD_SIZE"] = str(world_size)
         os.environ["RT_RANK"] = str(rank)
+        if backend == "torch":
+            import torch.distributed as dist
+
+            if not dist.is_initialized():
+                dist.init_process_group(
+                    "gloo", init_method=f"tcp://{coordinator}",
+                    rank=rank, world_size=world_size,
+                )
+            return True
         if not enabled or world_size <= 1:
             return True
         import jax
@@ -91,10 +102,12 @@ class TrainWorker:
 
 
 class WorkerGroup:
-    def __init__(self, scaling: ScalingConfig, run_name: str, storage_path: str):
+    def __init__(self, scaling: ScalingConfig, run_name: str,
+                 storage_path: str, backend: str = "jax"):
         self.scaling = scaling
         self.run_name = run_name
         self.storage_path = storage_path
+        self.backend = backend
         self.pg = None
         self.workers: list = []
 
@@ -149,7 +162,8 @@ class WorkerGroup:
         ray_tpu.get(
             [
                 w.setup_distributed.remote(
-                    coordinator, n, rank, self.scaling.jax_distributed
+                    coordinator, n, rank, self.scaling.jax_distributed,
+                    self.backend,
                 )
                 for rank, w in enumerate(self.workers)
             ],

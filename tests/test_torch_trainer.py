@@ -1,0 +1,100 @@
+"""TorchTrainer: DDP gang training on the actor core
+(model: reference python/ray/train/tests/test_torch_trainer.py —
+multi-worker DDP convergence + gradient sync)."""
+import numpy as np
+
+
+def test_torch_trainer_ddp_converges_and_syncs(ray_start):
+    from ray_tpu.train import ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def train_loop(config):
+        import torch
+        import torch.distributed as dist
+        from torch import nn
+
+        from ray_tpu.train import session
+        from ray_tpu.train.torch import prepare_model
+
+        torch.manual_seed(0)  # identical init on every rank
+        model = prepare_model(nn.Linear(8, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        rank = dist.get_rank()
+        g = torch.Generator().manual_seed(100 + rank)  # different data
+        X = torch.randn(64, 8, generator=g)
+        w_true = torch.arange(8, dtype=torch.float32)
+        y = (X @ w_true)[:, None]
+        loss = None
+        for _ in range(30):
+            opt.zero_grad()
+            loss = ((model(X) - y) ** 2).mean()
+            loss.backward()  # DDP all-reduces gradients here
+            opt.step()
+        p = [t.detach().numpy().copy() for t in model.parameters()]
+        flat = np.concatenate([a.reshape(-1) for a in p])
+        session.report({
+            "loss": float(loss),
+            "rank": rank,
+            "param_sum": float(flat.sum()),
+            "param_digest": float(np.abs(flat).sum()),
+        })
+
+    trainer = TorchTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 1}),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["loss"] < 2.0  # converged from ~E[y^2]=~35
+
+
+def test_torch_trainer_single_worker_no_ddp(ray_start):
+    from ray_tpu.train import ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def train_loop():
+        import torch.distributed as dist
+        from torch import nn
+
+        from ray_tpu.train import session
+        from ray_tpu.train.torch import prepare_model
+
+        model = prepare_model(nn.Linear(2, 1))
+        # world_size 1: not wrapped in DDP
+        session.report({
+            "wrapped": type(model).__name__,
+            "world": dist.get_world_size(),
+        })
+
+    result = TorchTrainer(
+        train_loop, scaling_config=ScalingConfig(num_workers=1),
+    ).fit()
+    assert result.error is None, result.error
+    assert result.metrics["wrapped"] == "Linear"
+    assert result.metrics["world"] == 1
+
+
+def test_prepare_data_loader_shards(ray_start):
+    from ray_tpu.train import ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def train_loop():
+        import torch
+        from torch.utils.data import DataLoader, TensorDataset
+
+        from ray_tpu.train import session
+        from ray_tpu.train.torch import prepare_data_loader
+
+        ds = TensorDataset(torch.arange(40, dtype=torch.float32)[:, None])
+        loader = prepare_data_loader(
+            DataLoader(ds, batch_size=5), shuffle=False)
+        seen = sum(len(b[0]) for b in loader)
+        session.report({"seen": seen})
+
+    result = TorchTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 1}),
+    ).fit()
+    assert result.error is None, result.error
+    # DistributedSampler gives each of 2 ranks half the 40 rows
+    assert result.metrics["seen"] == 20
