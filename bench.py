@@ -241,23 +241,33 @@ def main() -> None:
     # Orchestration-overhead parity (the reference's REAL acceptance bar:
     # <=~2.5% vs native, benchmarks.rst:56): measured in a CPU subprocess so
     # it cannot disturb the chip result; skipped if the budget is tight.
-    remaining = budget - (time.monotonic() - start) - 30.0
-    if remaining > 60.0:
+    def aux_bench(module: str, key: str, min_budget: float) -> None:
+        """Auxiliary CPU-subprocess metric: runs only with budget to spare
+        (so it cannot disturb the chip result) and merges ONE key into the
+        published result. Failures never lose the main number."""
+        remaining = budget - (time.monotonic() - start) - 30.0
+        if remaining <= min_budget:
+            return
         try:
             import subprocess
             import sys
 
             env = dict(os.environ, JAX_PLATFORMS="cpu")
             r = subprocess.run(
-                [sys.executable, "-m", "ray_tpu.benchmarks.trainer_overhead"],
+                [sys.executable, "-m", module],
                 capture_output=True, text=True, timeout=remaining, env=env,
             )
             if r.returncode == 0:
-                overhead = json.loads(r.stdout.strip().splitlines()[-1])
-                result["trainer_overhead_pct"] = overhead["trainer_overhead_pct"]
+                parsed = json.loads(r.stdout.strip().splitlines()[-1])
+                result[key] = parsed[key]
                 _publish(result)
         except Exception:
-            pass  # parity measure is auxiliary; never lose the main number
+            pass
+
+    # the reference's REAL acceptance bar (<=~2.5% vs native,
+    # benchmarks.rst:56), then the second north-star metric (BASELINE.json)
+    aux_bench("ray_tpu.benchmarks.trainer_overhead", "trainer_overhead_pct", 60.0)
+    aux_bench("ray_tpu.benchmarks.rllib_throughput", "ppo_env_steps_per_sec", 90.0)
     if _claim_print():
         print(json.dumps(result), flush=True)
     os._exit(0)

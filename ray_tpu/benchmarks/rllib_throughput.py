@@ -1,0 +1,51 @@
+"""RLlib PPO sampling+training throughput (env steps/sec).
+
+The second north-star metric (BASELINE.json: "RLlib PPO env-steps/sec").
+The reference publishes no PPO-throughput number, so this self-baselines
+(BASELINE.md notes the same for `ray microbenchmark`): PPO on CartPole
+with a local EnvRunner, measuring LIFETIME env steps sampled per second of
+wall clock across full train iterations — sampling, GAE, minibatch epochs,
+and weight broadcast all included, the same accounting RLlib's
+`num_env_steps_sampled_lifetime / time` gives. Prints one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+ITERATIONS = 12
+WARMUP_ITERS = 2
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_runner=16, rollout_length=128)
+        .training(minibatch_size=512, num_epochs=4)
+        .debugging(seed=0)
+        .build()
+    )
+    for _ in range(WARMUP_ITERS):  # compile + buffer warmup excluded
+        algo.train()
+    base_steps = algo._total_env_steps
+    t0 = time.perf_counter()
+    last = {}
+    for _ in range(ITERATIONS):
+        last = algo.train()
+    dt = time.perf_counter() - t0
+    steps = algo._total_env_steps - base_steps
+    print(json.dumps({
+        "ppo_env_steps_per_sec": round(steps / dt, 1),
+        "episode_return_mean": round(last.get("episode_return_mean", 0.0), 1),
+        "iterations": ITERATIONS,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
