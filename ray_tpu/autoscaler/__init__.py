@@ -6,6 +6,7 @@ slice-granular; providers are pluggable (fake in-process provider for tests,
 cloud providers implement the same 4-method contract).
 """
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.v2 import AutoscalerV2, InstanceManager, Reconciler
 from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider, NodeProvider
 from ray_tpu.autoscaler.resource_demand_scheduler import (
     NodeTypeConfig,
@@ -13,7 +14,10 @@ from ray_tpu.autoscaler.resource_demand_scheduler import (
 )
 
 __all__ = [
+    "AutoscalerV2",
     "FakeMultiNodeProvider",
+    "InstanceManager",
+    "Reconciler",
     "NodeProvider",
     "NodeTypeConfig",
     "StandardAutoscaler",

@@ -21,32 +21,23 @@ from ray_tpu.autoscaler.resource_demand_scheduler import (
 )
 
 
-class StandardAutoscaler:
-    def __init__(
-        self,
-        gcs_address: str,
-        provider: NodeProvider,
-        node_types: dict[str, NodeTypeConfig],
-        idle_timeout_s: float = 30.0,
-        update_interval_s: float = 1.0,
-    ):
-        self.provider = provider
-        self.node_types = dict(node_types)
-        self.idle_timeout_s = idle_timeout_s
+class GcsPollingLoop:
+    """Shared driver-loop plumbing for both autoscaler generations: a
+    background update() ticker plus the GCS snapshot (nodes, demand shapes,
+    available capacity) each pass consumes."""
+
+    def __init__(self, gcs_address: str, update_interval_s: float,
+                 thread_name: str):
         self.update_interval_s = update_interval_s
         self._gcs = RpcClient(gcs_address)
-        self._idle_since: dict[str, float] = {}  # provider id -> ts
-        self._launched_at: dict[str, float] = {}  # provider id -> ts
-        self.launch_grace_s = 120.0  # registration deadline for new nodes
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
+        self._thread_name = thread_name
         self.last_status: dict = {}
-
-    # -- lifecycle --
 
     def start(self) -> None:
         self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="autoscaler"
+            target=self._loop, daemon=True, name=self._thread_name
         )
         self._thread.start()
 
@@ -62,24 +53,48 @@ class StandardAutoscaler:
                 if self._stopped.is_set():
                     return
 
-    # -- one reconcile pass (reference: autoscaler.py:171 update) --
+    def update(self) -> dict:  # pragma: no cover - subclass responsibility
+        raise NotImplementedError
 
-    def update(self) -> dict:
+    def _gcs_snapshot(self) -> tuple[dict[bytes, dict], list[dict], list[dict]]:
         nodes = {
             n["node_id"]: n
             for n in self._gcs.call("get_nodes")["nodes"]
             if n["alive"]
         }
-        managed = self.provider.non_terminated_nodes()
-        counts: dict[str, int] = {}
-        for pid, t in managed.items():
-            counts[t] = counts.get(t, 0) + 1
-
         demands: list[dict] = []
         capacity: list[dict] = []
         for n in nodes.values():
             demands.extend(n.get("pending_shapes", []))
             capacity.append(dict(n.get("available", n["resources"])))
+        return nodes, demands, capacity
+
+
+class StandardAutoscaler(GcsPollingLoop):
+    def __init__(
+        self,
+        gcs_address: str,
+        provider: NodeProvider,
+        node_types: dict[str, NodeTypeConfig],
+        idle_timeout_s: float = 30.0,
+        update_interval_s: float = 1.0,
+    ):
+        super().__init__(gcs_address, update_interval_s, "autoscaler")
+        self.provider = provider
+        self.node_types = dict(node_types)
+        self.idle_timeout_s = idle_timeout_s
+        self._idle_since: dict[str, float] = {}  # provider id -> ts
+        self._launched_at: dict[str, float] = {}  # provider id -> ts
+        self.launch_grace_s = 120.0  # registration deadline for new nodes
+
+    # -- one reconcile pass (reference: autoscaler.py:171 update) --
+
+    def update(self) -> dict:
+        nodes, demands, capacity = self._gcs_snapshot()
+        managed = self.provider.non_terminated_nodes()
+        counts: dict[str, int] = {}
+        for pid, t in managed.items():
+            counts[t] = counts.get(t, 0) + 1
 
         to_launch = get_nodes_to_launch(
             self.node_types, counts, capacity, demands

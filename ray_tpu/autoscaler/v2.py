@@ -1,0 +1,267 @@
+"""Autoscaler v2 — the instance-manager / scheduler / reconciler split.
+
+Equivalent of the reference's autoscaler v2 (reference:
+python/ray/autoscaler/v2/ — instance_manager/instance_manager.py holds a
+versioned instance table behind an update API; instance lifecycle states
+instance_manager/common.py InstanceUtil; the Reconciler
+(instance_manager/reconciler.py) converges the table against cloud-provider
+and Ray-cluster reality each tick; scheduler.py computes desired
+instances from demand). StandardAutoscaler (autoscaler.py) remains the
+merged v1; this module separates the concerns so each is independently
+testable and replaceable:
+
+  * InstanceManager — the ONLY component that mutates instance state; a
+    versioned table with compare-and-swap updates (the reference's
+    protocol boundary, gRPC there, in-process here).
+  * Reconciler — pure logic: given the table + provider view + GCS view +
+    demand, emits InstanceUpdates and provider actions.
+  * AutoscalerV2 — the driver loop wiring them to a NodeProvider and GCS.
+
+Lifecycle: QUEUED → REQUESTED → ALLOCATED → RAY_RUNNING → TERMINATING →
+TERMINATED (plus ALLOCATION_FAILED for launch-deadline misses).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.autoscaler.autoscaler import GcsPollingLoop
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    NodeTypeConfig,
+    get_nodes_to_launch,
+)
+
+# instance lifecycle states (reference: instance_manager/common.py)
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+ALLOCATION_FAILED = "ALLOCATION_FAILED"
+
+_LIVE_STATES = (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING, TERMINATING)
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = QUEUED
+    provider_id: Optional[str] = None
+    ray_node_id: Optional[bytes] = None
+    status_since: float = field(default_factory=time.monotonic)
+    idle_since: Optional[float] = None
+
+
+@dataclass
+class InstanceUpdate:
+    instance_id: str
+    new_status: str
+    provider_id: Optional[str] = None
+    ray_node_id: Optional[bytes] = None
+    idle_since: Optional[float] = None
+
+
+class InstanceManager:
+    """Versioned instance table; updates go through update_instance_states
+    with an expected version (compare-and-swap, the reference's protocol:
+    instance_manager.py UpdateInstanceManagerStateRequest.expected_version).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instances: dict[str, Instance] = {}
+        self._version = 0
+
+    def get_state(self) -> tuple[int, dict[str, Instance]]:
+        with self._lock:
+            return self._version, {
+                k: Instance(**vars(v)) for k, v in self._instances.items()
+            }
+
+    def add_instances(self, node_types: list[str],
+                      expected_version: int) -> bool:
+        updates = []
+        for t in node_types:
+            iid = uuid.uuid4().hex[:12]
+            updates.append((iid, Instance(instance_id=iid, node_type=t)))
+        with self._lock:
+            if expected_version != self._version:
+                return False
+            for iid, inst in updates:
+                self._instances[iid] = inst
+            self._version += 1
+            return True
+
+    # terminal instances older than this are garbage-collected (the
+    # reference likewise GCs stopped instances from the table)
+    TERMINAL_RETENTION_S = 300.0
+
+    def update_instance_states(self, updates: list[InstanceUpdate],
+                               expected_version: int) -> bool:
+        with self._lock:
+            if expected_version != self._version:
+                return False
+            for u in updates:
+                inst = self._instances.get(u.instance_id)
+                if inst is None:
+                    continue
+                if u.new_status != inst.status:
+                    inst.status = u.new_status
+                    inst.status_since = time.monotonic()
+                if u.provider_id is not None:
+                    inst.provider_id = u.provider_id
+                if u.ray_node_id is not None:
+                    inst.ray_node_id = u.ray_node_id
+                inst.idle_since = u.idle_since
+            self._version += 1
+            # GC: the table must not grow with cluster churn
+            cutoff = time.monotonic() - self.TERMINAL_RETENTION_S
+            dead = [
+                k for k, i in self._instances.items()
+                if i.status in (TERMINATED, ALLOCATION_FAILED)
+                and i.status_since < cutoff
+            ]
+            for k in dead:
+                del self._instances[k]
+            return True
+
+
+class Reconciler:
+    """One converge pass (reference: reconciler.py Reconcile). Pure with
+    respect to the instance table: reads a snapshot, returns the updates
+    and performs provider actions."""
+
+    def __init__(self, node_types: dict[str, NodeTypeConfig],
+                 idle_timeout_s: float = 30.0, launch_grace_s: float = 120.0):
+        self.node_types = dict(node_types)
+        self.idle_timeout_s = idle_timeout_s
+        self.launch_grace_s = launch_grace_s
+
+    def step(self, im: InstanceManager, provider: NodeProvider,
+             gcs_nodes: dict[bytes, dict], demands: list[dict],
+             capacity: list[dict]) -> dict:
+        version, instances = im.get_state()
+        updates: list[InstanceUpdate] = []
+        now = time.monotonic()
+        actions = {"launched": 0, "terminated": 0, "failed": 0}
+
+        live_by_type: dict[str, int] = {}
+        for inst in instances.values():
+            if inst.status in _LIVE_STATES:
+                live_by_type[inst.node_type] = (
+                    live_by_type.get(inst.node_type, 0) + 1)
+
+        created: list[str] = []  # provider ids from THIS pass (compensation)
+        for inst in instances.values():
+            if inst.status == QUEUED:
+                # request from the cloud provider
+                pid = provider.create_node(
+                    inst.node_type,
+                    dict(self.node_types[inst.node_type].resources),
+                )
+                created.append(pid)
+                updates.append(InstanceUpdate(
+                    inst.instance_id, ALLOCATED, provider_id=pid))
+                actions["launched"] += 1
+            elif inst.status == ALLOCATED:
+                rid = provider.internal_id(inst.provider_id)
+                info = gcs_nodes.get(rid) if rid else None
+                if info is not None:
+                    updates.append(InstanceUpdate(
+                        inst.instance_id, RAY_RUNNING, ray_node_id=rid))
+                elif now - inst.status_since > self.launch_grace_s:
+                    provider.terminate_node(inst.provider_id)
+                    updates.append(InstanceUpdate(
+                        inst.instance_id, ALLOCATION_FAILED))
+                    actions["failed"] += 1
+            elif inst.status == RAY_RUNNING:
+                info = gcs_nodes.get(inst.ray_node_id)
+                if info is None:
+                    # node died outside our control
+                    updates.append(InstanceUpdate(inst.instance_id, TERMINATED))
+                    continue
+                avail = info.get("available", info["resources"])
+                busy = (
+                    any(avail.get(k, 0) < v
+                        for k, v in info["resources"].items())
+                    or info.get("load", 0) > 0
+                    or info.get("pending_shapes")
+                )
+                if busy:
+                    updates.append(InstanceUpdate(
+                        inst.instance_id, RAY_RUNNING, idle_since=None))
+                    continue
+                idle_since = inst.idle_since or now
+                floor = self.node_types[inst.node_type].min_workers
+                if (now - idle_since >= self.idle_timeout_s
+                        and live_by_type.get(inst.node_type, 0) > floor):
+                    updates.append(InstanceUpdate(
+                        inst.instance_id, TERMINATING))
+                    live_by_type[inst.node_type] -= 1
+                else:
+                    updates.append(InstanceUpdate(
+                        inst.instance_id, RAY_RUNNING, idle_since=idle_since))
+            elif inst.status == TERMINATING:
+                provider.terminate_node(inst.provider_id)
+                updates.append(InstanceUpdate(inst.instance_id, TERMINATED))
+                actions["terminated"] += 1
+
+        if updates:
+            if not im.update_instance_states(updates, version):
+                # another writer won the CAS mid-pass: our provider actions
+                # are untracked — COMPENSATE by terminating what we just
+                # created (the instances stay QUEUED and relaunch next
+                # tick), and skip scale-up this pass
+                for pid in created:
+                    provider.terminate_node(pid)
+                actions["cas_lost"] = True
+                return actions
+            version, instances = im.get_state()
+
+        # scale up: unmet demand → new QUEUED instances
+        counts = {
+            t: sum(1 for i in instances.values()
+                   if i.node_type == t and i.status in _LIVE_STATES)
+            for t in self.node_types
+        }
+        to_launch = get_nodes_to_launch(
+            self.node_types, counts, capacity, demands)
+        queue: list[str] = []
+        for t, n in to_launch.items():
+            queue.extend([t] * n)
+        if queue:
+            # CAS failure here loses nothing irreversible: the demand is
+            # still unmet and re-queues next tick
+            im.add_instances(queue, version)
+        actions["queued"] = len(queue)
+        return actions
+
+
+class AutoscalerV2(GcsPollingLoop):
+    """Driver loop: GCS view + demand in, reconciler pass per tick."""
+
+    def __init__(self, gcs_address: str, provider: NodeProvider,
+                 node_types: dict[str, NodeTypeConfig],
+                 idle_timeout_s: float = 30.0,
+                 update_interval_s: float = 1.0):
+        super().__init__(gcs_address, update_interval_s, "autoscaler-v2")
+        self.im = InstanceManager()
+        self.reconciler = Reconciler(node_types, idle_timeout_s)
+        self.provider = provider
+        # serializes the background ticker against manual update() calls so
+        # reconcile passes never interleave (a lost CAS mid-pass would
+        # otherwise force provider-side compensation)
+        self._update_lock = threading.Lock()
+
+    def update(self) -> dict:
+        with self._update_lock:
+            nodes, demands, capacity = self._gcs_snapshot()
+            self.last_status = self.reconciler.step(
+                self.im, self.provider, nodes, demands, capacity)
+            return self.last_status
