@@ -61,6 +61,9 @@ class WorkerHandle:
 
 
 class Raylet:
+    # strict-mode wire validation against schema.SCHEMAS["raylet"] (rpc.py)
+    schema_service = "raylet"
+
     def __init__(
         self,
         node_id: NodeID,

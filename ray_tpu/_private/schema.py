@@ -80,6 +80,21 @@ SCHEMAS: dict[str, dict[str, dict]] = {
         "add_task_events": _spec("events"),
         "list_task_events": _spec("job_id"),
     },
+    "raylet": {
+        "pull_object": _spec("object_id", "length offset"),
+        "fetch_object": _spec("object_id"),
+        "free_object": _spec("object_id"),
+        "register_worker": _spec("worker_id", "pid"),
+        "submit_task": _spec("spec"),
+        "actor_started": _spec("actor_id worker_id"),
+        "kill_actor": _spec("actor_id"),
+        "task_done": _spec("", "task_id"),
+        "prepare_bundle": _spec("pg_id bundle_index resources"),
+        "commit_bundle": _spec("pg_id bundle_index"),
+        "cancel_bundle": _spec("pg_id bundle_index"),
+        "return_bundle": _spec("pg_id bundle_index"),
+        "node_stats": _spec(),
+    },
 }
 
 

@@ -18,6 +18,7 @@ from ray_tpu.data.datasource import (
     range,
     range_tensor,
     read_csv,
+    read_binary_files,
     read_images,
     read_json,
     read_numpy,
@@ -40,6 +41,7 @@ __all__ = [
     "range",
     "range_tensor",
     "read_csv",
+    "read_binary_files",
     "read_images",
     "read_json",
     "read_numpy",

@@ -190,3 +190,21 @@ def read_images(paths, *, size: Optional[tuple] = None,
         return t.append_column("path", pa.array([path]))
 
     return Dataset([_Read(files, read)])
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      parallelism: int = -1) -> Dataset:
+    """One row per file with its raw bytes (reference:
+    python/ray/data/read_api.py read_binary_files) — the generic ingest for
+    audio/archives/protos that downstream map_batches decode."""
+    files = _resolve_paths(paths)
+
+    def read(path) -> pa.Table:
+        with open(path, "rb") as f:
+            data = f.read()
+        cols = {"bytes": pa.array([data], type=pa.binary())}
+        if include_paths:
+            cols["path"] = pa.array([path])
+        return pa.table(cols)
+
+    return Dataset([_Read(files, read)])

@@ -402,3 +402,17 @@ def test_map_batches_actor_pool_autoscales(ray_start):
                                   max_tasks_in_flight_per_actor=1),
     )
     assert sorted(r["id"] for r in ds.take_all()) == list(range(48))
+
+
+def test_read_binary_files(ray_start):
+    from ray_tpu import data
+
+    d = tempfile.mkdtemp()
+    for i in range(3):
+        with open(os.path.join(d, f"f{i}.bin"), "wb") as f:
+            f.write(bytes([i]) * (10 + i))
+    ds = data.read_binary_files(d, include_paths=True)
+    rows = ds.take_all()
+    assert len(rows) == 3
+    by_path = {os.path.basename(r["path"]): r["bytes"] for r in rows}
+    assert by_path["f1.bin"] == bytes([1]) * 11

@@ -60,16 +60,18 @@ def test_strict_mode_rejects_bad_payloads(monkeypatch):
 
 
 def test_schema_table_matches_gcs_handlers():
-    """Every schema entry corresponds to a real GCS handler, and every
-    GCS handler has a schema entry — the table cannot drift silently."""
+    """Every schema entry corresponds to a real handler, and every handler
+    has a schema entry — the tables cannot drift silently."""
     from ray_tpu._private.gcs import GcsService
+    from ray_tpu._private.raylet import Raylet
 
-    handlers = {n[len("rpc_"):] for n in dir(GcsService)
-                if n.startswith("rpc_")}
-    declared = set(schema.SCHEMAS["gcs"])
-    assert declared <= handlers, f"schema for ghosts: {declared - handlers}"
-    missing = handlers - declared
-    assert not missing, f"handlers without schema: {missing}"
+    for service, table in (("gcs", GcsService), ("raylet", Raylet)):
+        handlers = {n[len("rpc_"):] for n in dir(table)
+                    if n.startswith("rpc_")}
+        declared = set(schema.SCHEMAS[service])
+        assert declared <= handlers, (service, declared - handlers)
+        missing = handlers - declared
+        assert not missing, (service, missing)
 
 
 def test_validate_request_shapes():
