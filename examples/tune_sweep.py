@@ -1,0 +1,45 @@
+"""Hyperparameter sweep: native TPE searcher under ASHA early stopping.
+
+Mirrors the reference's tune quickstart (doc/source/tune/getting_started)
+with the in-tree BOHB-style composition (model-based suggestions + ASHA).
+
+Run: python examples/tune_sweep.py
+"""
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import ASHAScheduler
+
+
+def objective(config):
+    x, lr = config["x"], config["lr"]
+    for i in range(5):
+        # pretend training: best at x=0.3, lr=1e-2
+        score = -((x - 0.3) ** 2) - abs(lr - 1e-2) * 10 - 0.01 * (5 - i)
+        tune.report({"score": score})
+
+
+def main():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    tuner = tune.Tuner(
+        objective,
+        tune_config=tune.TuneConfig(
+            metric="score",
+            mode="max",
+            search_alg=tune.TPESearcher(
+                {"x": tune.uniform(0, 1), "lr": tune.loguniform(1e-4, 1e-1)},
+                n_startup=5, max_trials=15, seed=0,
+            ),
+            scheduler=ASHAScheduler(max_t=5, grace_period=1),
+            max_concurrent_trials=2,
+        ),
+        run_config=tune.TuneRunConfig(name="tpe-example"),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    print("best config:", best.config, "score:", best.metrics["score"])
+    assert abs(best.config["x"] - 0.3) < 0.5
+    return best
+
+
+if __name__ == "__main__":
+    main()

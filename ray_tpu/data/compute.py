@@ -28,6 +28,11 @@ class ActorPoolStrategy:
     queued and more input is waiting; it never shrinks mid-stage (actors are
     killed when the stage drains). Mirrors the reference's
     ``ActorPoolMapOperator`` scaling rule without its rate heuristics.
+
+    Resource note (same hazard as the reference's actor pools): each actor
+    RESERVES ``num_cpus`` for the stage's lifetime while upstream read/map
+    TASKS still need free slots — a pool sized to the whole cluster starves
+    its own input. Keep min_size below the cluster's CPU count.
     """
 
     min_size: int = 1
