@@ -174,6 +174,58 @@ def run_bench(batch_size: int = 256, steps: int = 60, warmup: int = 5,
     return _make_result(batch_size * steps / dt, platform, image_size, peak, tag)
 
 
+def _outer() -> None:
+    """Supervisor mode: run the real bench in a SUBPROCESS so a hung
+    device backend (an in-process stall no watchdog can interrupt — the
+    round-2 failure mode) can be abandoned and the measurement retried on
+    the CPU backend, honestly labeled. Exactly ONE JSON line reaches
+    stdout either way."""
+    import subprocess
+    import sys
+
+    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
+
+    def attempt(extra_env: dict, share: float) -> dict | None:
+        env = dict(os.environ, BENCH_INNER="1",
+                   BENCH_BUDGET_S=str(max(60.0, budget * share)), **extra_env)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True,
+                timeout=budget * share + 45.0, env=env,
+            )
+            for line in reversed(r.stdout.strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                    if "metric" in parsed:
+                        return parsed
+                except json.JSONDecodeError:
+                    continue
+        except Exception:
+            return None
+        return None
+
+    result = attempt({}, 0.60)
+    if result is None or result.get("value", 0) <= 0:
+        # device backend unreachable: measure on CPU so a REAL number
+        # lands, tagged by platform in the metric name + an explicit flag
+        cpu = attempt({"JAX_PLATFORMS": "cpu", "BENCH_STEPS": "8",
+                       "BENCH_BATCH_SIZE": "64", "BENCH_IMAGE_SIZE": "96"},
+                      0.30)
+        if cpu is not None:
+            cpu["tpu_stalled"] = True
+            result = cpu
+    if result is None:
+        result = {
+            "metric": "resnet50_train_images_per_sec_per_chip_timeout",
+            "value": 0.0,
+            "unit": "images/sec",
+            "vs_baseline": 0.0,
+            "error": "backend stall on both device and cpu attempts",
+        }
+    print(json.dumps(result), flush=True)
+
+
 def main() -> None:
     import sys
 
@@ -274,4 +326,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_INNER") == "1":
+        main()
+    else:
+        _outer()
