@@ -221,15 +221,7 @@ class Raylet:
                         # no tombstone in the new incarnation)
                         self._cluster_seq = 0
                         self._cluster_view = {}
-                with self._lock:
-                    if reply.get("full"):
-                        self._cluster_view = {}
-                    for n in reply.get("delta", ()):
-                        self._cluster_view[n["node_id"]] = n
-                    for nid in reply.get("removed", ()):
-                        self._cluster_view.pop(nid, None)
-                    if "seq" in reply:
-                        self._cluster_seq = reply["seq"]
+                self._apply_cluster_delta(reply)
             except Exception:
                 if self._stopped.is_set():
                     return
@@ -244,6 +236,22 @@ class Raylet:
                     self.gcs = RpcClient(self.gcs_address)
                 except Exception:  # noqa: BLE001
                     pass
+
+    def _apply_cluster_delta(self, reply: dict) -> None:
+        """Merge one heartbeat reply's node-table changes into the local
+        cluster view. Tombstones FIRST: a node that died and revived within
+        one sync window appears in both lists, and its delta entry is always
+        newer than its tombstone — applying delta last keeps the revived
+        node visible (reference: ray_syncer versioned merge semantics)."""
+        with self._lock:
+            if reply.get("full"):
+                self._cluster_view = {}
+            for nid in reply.get("removed", ()):
+                self._cluster_view.pop(nid, None)
+            for n in reply.get("delta", ()):
+                self._cluster_view[n["node_id"]] = n
+            if "seq" in reply:
+                self._cluster_seq = reply["seq"]
 
     def _idle_reaper_loop(self) -> None:
         """Reap long-idle task workers down to one warm worker so an idle

@@ -164,6 +164,7 @@ class RpcServer:
             ).start()
 
     def _serve_conn(self, conn: Connection) -> None:
+        handshaken = False
         try:
             while not self._stopped.is_set():
                 msg = _read_msg(conn.sock)
@@ -180,8 +181,18 @@ class RpcServer:
                     try:
                         conn.send([RESPONSE, msgid, True,
                                    schema.check_handshake(payload)])
+                        handshaken = True
                     except schema.SchemaError as e:
                         conn.send([RESPONSE, msgid, False, str(e)])
+                    continue
+                if self._strict and not handshaken:
+                    # the documented contract (docs/CROSS_LANGUAGE.md): the
+                    # FIRST call on a connection must be _handshake; in
+                    # strict mode enforce it server-side so incompatible
+                    # clients can't bypass version detection
+                    conn.send([RESPONSE, msgid, False,
+                               "protocol error: first request on a "
+                               "connection must be _handshake (strict mode)"])
                     continue
                 handler = getattr(self.service, "rpc_" + method, None)
                 if handler is None:

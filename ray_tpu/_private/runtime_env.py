@@ -104,17 +104,31 @@ def ensure_pip_env(pip) -> str:
             # a creator killed mid-install leaves the lock forever: steal
             # stale locks (no .ready/.failed and no mtime progress) and
             # retry the build ourselves
+            lock_alive = True
             try:
                 age = time.time() - os.path.getmtime(lock_dir)
             except OSError:
-                age = 0.0  # lock vanished: winner just finished/cleaned up
+                # lock vanished: winner just finished (ready lands next
+                # poll) OR crashed between rmdir and ready — retry the
+                # build ourselves rather than waiting on nothing
+                lock_alive = False
+                age = 0.0
+            if not lock_alive and not os.path.exists(ready):
+                return ensure_pip_env(pip)
             if age > 600:
                 with contextlib.suppress(OSError):
                     os.rmdir(lock_dir)
                 return ensure_pip_env(pip)
             if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"pip runtime_env {key} not ready after 300s")
+                # a live creator refreshes the lock mtime every 30s; a long
+                # (>5 min) but progressing install must not strand waiters —
+                # extend the deadline while progress is visible
+                if lock_alive and age < 120:
+                    deadline = time.monotonic() + 120
+                else:
+                    raise TimeoutError(
+                        f"pip runtime_env {key} not ready after 300s "
+                        f"with no creator progress for {int(age)}s")
             time.sleep(0.2)
         return site
     try:

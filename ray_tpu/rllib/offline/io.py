@@ -15,6 +15,15 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 
+def _encode_action(a):
+    """Scalar (discrete) actions → int/float; vector (continuous, SAC/TD3)
+    actions → list, mirroring the obs handling."""
+    arr = np.asarray(a)
+    if arr.ndim == 0:
+        return float(arr) if np.issubdtype(arr.dtype, np.floating) else int(arr)
+    return arr.tolist()
+
+
 class JsonWriter:
     """Append rollout batches ([T, E, ...] dicts from EnvRunner.sample) or
     single transitions to a JSONL file."""
@@ -38,7 +47,7 @@ class JsonWriter:
                 row = {
                     "eps_id": self._eps_cur[e],
                     "obs": batch["obs"][t, e].tolist(),
-                    "action": int(batch["actions"][t, e]),
+                    "action": _encode_action(batch["actions"][t, e]),
                     "reward": float(batch["rewards"][t, e]),
                     "done": bool(batch["dones"][t, e]),
                     "terminated": bool(batch["terminateds"][t, e]),
@@ -52,13 +61,13 @@ class JsonWriter:
         self._f.flush()
         return n
 
-    def write_transition(self, eps_id: int, obs, action: int, reward: float,
+    def write_transition(self, eps_id: int, obs, action, reward: float,
                          done: bool, terminated: Optional[bool] = None,
                          **extra) -> None:
         row = {
             "eps_id": int(eps_id),
             "obs": np.asarray(obs, np.float32).tolist(),
-            "action": int(action),
+            "action": _encode_action(action),
             "reward": float(reward),
             "done": bool(done),
             "terminated": bool(done if terminated is None else terminated),
@@ -151,8 +160,13 @@ def compute_returns(episodes: List[List[dict]], gamma: float):
             obs.append(row["obs"])
             actions.append(row["action"])
             returns.append(rets[i])
+    acts = np.asarray(actions)
+    # discrete rows deserialize as python ints → int32; continuous rows
+    # (vectors or floats) keep float32
+    acts = (acts.astype(np.int32) if np.issubdtype(acts.dtype, np.integer)
+            else acts.astype(np.float32))
     return (
         np.asarray(obs, np.float32),
-        np.asarray(actions, np.int32),
+        acts,
         np.asarray(returns, np.float32),
     )

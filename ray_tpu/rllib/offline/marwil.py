@@ -92,6 +92,20 @@ class MARWIL(Algorithm):
         )
         if len(self._actions) == 0:
             raise ValueError("offline input is empty")
+        if self._actions.ndim != 1:
+            raise ValueError(
+                "MARWIL/BC requires discrete (scalar) actions; got "
+                f"action shape {self._actions.shape} — continuous-action "
+                "datasets (SAC/TD3 output) are not supported by this "
+                "discrete behavior-cloning family")
+        if not np.issubdtype(self._actions.dtype, np.integer):
+            # float-typed but integral-valued actions (e.g. hand-written
+            # datasets using 1.0) are fine; genuinely fractional are not
+            if not np.all(self._actions == np.round(self._actions)):
+                raise ValueError(
+                    "MARWIL/BC requires discrete actions; offline data "
+                    "contains fractional action values")
+            self._actions = self._actions.astype(np.int32)
         self.obs_dim = (cfg.observation_dim
                         or int(self._obs.shape[1]))
         self.num_actions = (cfg.num_actions

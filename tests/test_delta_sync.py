@@ -99,3 +99,36 @@ def test_raylet_view_converges(ray_cluster):
                 return
         time.sleep(0.2)
     raise AssertionError("head never dropped the removed node")
+
+
+def test_revived_node_survives_same_window_tombstone():
+    """A node that dies and re-registers within one sync window appears in
+    BOTH `removed` and `delta` for a stale peer; the raylet must apply the
+    tombstone first so the revived node stays visible (round-3 advisor
+    finding: delta-before-removed left revived idle nodes permanently
+    invisible)."""
+    import threading
+
+    from ray_tpu._private.raylet import Raylet
+
+    gcs = GcsService()
+    a, b = b"a" * 16, b"b" * 16
+    _reg(gcs, a)
+    _reg(gcs, b)
+    r = _hb(gcs, a, 0)
+    stale_seq_pre_death = r["seq"]
+    gcs.rpc_drain_node(None, 0, {"node_id": b})
+    _reg(gcs, b)  # revival: re-register after the tombstone
+    reply = _hb(gcs, a, stale_seq_pre_death)
+    assert b in reply["removed"]
+    assert any(n["node_id"] == b for n in reply["delta"])
+
+    class _View:
+        _lock = threading.Lock()
+        _cluster_view = {}
+        _cluster_seq = stale_seq_pre_death
+
+    view = _View()
+    Raylet._apply_cluster_delta(view, reply)
+    assert b in view._cluster_view, "revived node erased by stale tombstone"
+    assert view._cluster_seq == reply["seq"]

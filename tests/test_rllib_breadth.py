@@ -284,3 +284,31 @@ def test_output_config_writes_experiences():
         rows = list(JsonReader(out).iter_rows())
         assert len(rows) == 2 * 2 * 10  # 2 iters * E=2 * T=10
         assert {"eps_id", "obs", "action", "reward", "done"} <= set(rows[0])
+
+
+def test_json_writer_continuous_actions():
+    """Continuous (vector-float) actions serialize as lists and read back
+    as float32 arrays — enabling offline output on SAC/TD3 must not
+    TypeError (round-3 advisor finding)."""
+    from ray_tpu.rllib.offline import JsonReader, JsonWriter, compute_returns
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "cont.jsonl")
+        with JsonWriter(path) as w:
+            batch = {
+                "obs": np.zeros((3, 2, 4), np.float32),
+                "actions": np.full((3, 2, 1), 0.5, np.float32),
+                "rewards": np.ones((3, 2), np.float32),
+                "dones": np.array([[0, 0], [0, 0], [1, 1]], bool),
+                "terminateds": np.array([[0, 0], [0, 0], [1, 1]], bool),
+            }
+            n = w.write_batch(batch)
+            assert n == 6
+            # scalar float action via the single-transition path too
+            w.write_transition(99, [0.0] * 4, np.float32(0.25), 1.0, True)
+        eps = JsonReader(path).episodes()
+        obs, actions, rets = compute_returns(
+            [ep for ep in eps if len(ep) > 1], gamma=0.9)
+        assert actions.dtype == np.float32
+        assert actions.shape == (6, 1)
+        assert float(actions[0, 0]) == pytest.approx(0.5)

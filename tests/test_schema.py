@@ -83,3 +83,21 @@ def test_validate_request_shapes():
     # unknown service/method: opaque, no error
     schema.validate_request("nope", "x", {"a": 1})
     schema.validate_request("gcs", "not_a_method", {"a": 1})
+
+
+def test_strict_server_rejects_skipped_handshake(monkeypatch):
+    """docs/CROSS_LANGUAGE.md: the FIRST call on a connection MUST be
+    _handshake. In strict mode the server enforces it rather than trusting
+    well-behaved clients (round-3 advisor finding)."""
+    monkeypatch.setenv("RAY_TPU_STRICT_SCHEMA", "1")
+    srv = RpcServer(_EchoService())
+    try:
+        c = RpcClient(srv.address, handshake=False)
+        with pytest.raises(RpcError, match="must be _handshake"):
+            c.call("kv_get", {"key": b"x"})
+        # handshaking late (after a rejection) unlocks the connection
+        c.call("_handshake", schema.handshake_payload())
+        assert c.call("kv_get", {"key": b"x"})["value"] == b"x"
+        c.close()
+    finally:
+        srv.stop()
