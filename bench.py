@@ -1,22 +1,29 @@
-"""Benchmark: ResNet-50 training throughput on TPU.
+"""Benchmark: GPT-2 (125M) training throughput on TPU — the headline —
+plus ResNet-50 as the secondary metric.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu", ...}.
 
-Baseline: the reference's published TorchTrainer ResNet image-training
-throughput on one GPU — 40.7 images/sec (BASELINE.md; reference:
-doc/source/train/benchmarks.rst:33-37, 1x g3.8xlarge, 1 worker). Ours is
-the same model family (ResNet-50, bf16) trained on one TPU chip with a
-jitted step; vs_baseline = value / 40.7.
+Headline: tokens/sec + MFU for a jitted GPT-2 125M train step (flash
+attention, bf16, donated buffers) — see ray_tpu/benchmarks/gpt_mfu.py. The
+reference publishes no transformer/TPU number (BASELINE.md), so the bar is
+self-set: 35% MFU; vs_baseline = mfu / 0.35. The secondary "resnet" entry
+keeps the round-1..3 comparison: images/sec vs the reference's published
+40.7 img/s 1-GPU TorchTrainer (doc/source/train/benchmarks.rst:33-37).
 
-Hardening (a backend stall must never produce zero output):
-- A watchdog thread holds the best result measured so far; when the
-  wall-clock budget expires it prints that JSON line and `os._exit`s —
-  a hung XLA call cannot be interrupted any other way.
-- A tiny probe run executes FIRST so a real number exists within ~a
-  minute even if the full-size run never completes.
-- The timed loop is chunked; each completed chunk updates the watchdog's
-  partial result, so a mid-run stall still reports measured throughput.
-- Persistent compilation cache so a rerun skips the ~compile cost.
+Hardening (a backend stall must never produce zero output, and an
+end-of-round stall must never erase the round's perf evidence):
+- Supervisor subprocess model: the real bench runs in a child; a hung
+  device backend is abandoned and the measurement retried on CPU,
+  honestly labeled (`tpu_stalled: true`).
+- A watchdog thread inside the child holds the best result measured so
+  far and prints it when the budget expires (`os._exit` — a hung XLA call
+  cannot be interrupted any other way).
+- The timed loops are chunked; each completed chunk updates the watchdog.
+- Every successful DEVICE measurement is persisted (timestamped) to
+  BENCH_LAST_GOOD.json at the repo root; on stall-fallback the emitted
+  line carries it as `last_good_device_result`.
+- BENCH_SIMULATE_STALL=1 forces the device attempt to hang (tests the
+  whole fallback + cache path without a real stall).
 """
 from __future__ import annotations
 
@@ -27,20 +34,15 @@ import time
 from functools import partial
 
 BASELINE_IMG_PER_SEC = 40.7  # reference 1-GPU TorchTrainer (BASELINE.md)
+MFU_BAR = 0.35  # self-set headline bar (VERDICT r3 #1); no reference number
 
 # ResNet-50 @224: ~4.09 GFLOPs forward per image; train step (fwd+bwd) ~3x.
 RESNET50_TRAIN_GFLOPS_PER_IMG_224 = 3.0 * 4.09
 
-# Known per-chip peak bf16 TFLOP/s by device_kind substring.
-_CHIP_PEAK_TFLOPS = [
-    ("v6", 918.0),
-    ("v5p", 459.0),
-    ("v5 lite", 197.0),
-    ("v5e", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+LAST_GOOD_PATH = os.environ.get(
+    "BENCH_LAST_GOOD_PATH", os.path.join(_REPO_ROOT, "BENCH_LAST_GOOD.json")
+)
 
 _state_lock = threading.Lock()
 _best_result: dict | None = None  # watchdog prints this on budget expiry
@@ -50,7 +52,21 @@ _printed = False  # exactly ONE JSON line may reach stdout
 def _publish(result: dict) -> None:
     global _best_result
     with _state_lock:
+        if _best_result is not None:
+            # keep secondary keys (resnet, aux metrics) already merged in
+            merged = dict(_best_result)
+            merged.update(result)
+            result = merged
         _best_result = result
+
+
+def _merge_key(key: str, value) -> None:
+    """Attach a secondary metric to the headline result without replacing it."""
+    global _best_result
+    with _state_lock:
+        if _best_result is None:
+            _best_result = {}
+        _best_result[key] = value
 
 
 def _claim_print() -> bool:
@@ -62,39 +78,70 @@ def _claim_print() -> bool:
         return True
 
 
+def _current_result() -> dict | None:
+    with _state_lock:
+        return dict(_best_result) if _best_result else None
+
+
 def _watchdog(budget_s: float) -> None:
     time.sleep(budget_s)
-    with _state_lock:
-        result = _best_result
+    result = _current_result()
     if not _claim_print():
         return
     if result is None:
         result = {
-            "metric": "resnet50_train_images_per_sec_per_chip_timeout",
+            "metric": "gpt2_train_tokens_per_sec_per_chip_timeout",
             "value": 0.0,
-            "unit": "images/sec",
+            "unit": "tokens/sec",
             "vs_baseline": 0.0,
             "error": "backend stall before any measurement completed",
         }
     else:
-        result = dict(result)
         result["partial"] = True
+    _save_last_good(result)
     print(json.dumps(result), flush=True)
     os._exit(0)
 
 
+def _save_last_good(result: dict) -> None:
+    """Persist a successful DEVICE measurement so a later environmental
+    stall cannot erase the round's perf evidence (VERDICT r3 weak #1)."""
+    try:
+        if not result or result.get("value", 0) <= 0:
+            return
+        if "_cpu" in result.get("metric", "") or result.get("tpu_stalled"):
+            return  # only real device numbers are worth caching
+        record = dict(result)
+        record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        record["measured_at_unix"] = round(time.time(), 1)
+        with open(LAST_GOOD_PATH + ".tmp", "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+        os.replace(LAST_GOOD_PATH + ".tmp", LAST_GOOD_PATH)
+    except Exception:
+        pass  # caching is best-effort; never fail the bench over it
+
+
+def _load_last_good() -> dict | None:
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
 def _chip_peak_tflops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for sub, peak in _CHIP_PEAK_TFLOPS:
-        if sub in kind:
-            return peak
-    if device.platform == "cpu":
-        return 0.5  # nominal; MFU on CPU is not meaningful
-    return 275.0  # assume v4-class if unknown
+    from ray_tpu.benchmarks.gpt_mfu import chip_peak_tflops
+
+    return chip_peak_tflops(device)
 
 
-def _make_result(images_per_sec: float, platform: str, image_size: int,
-                 peak_tflops: float, tag: str = "") -> dict:
+# ---------------------------------------------------------------------------
+# ResNet-50 secondary metric (rounds 1-3 headline, kept for continuity)
+# ---------------------------------------------------------------------------
+
+
+def _make_resnet_result(images_per_sec: float, platform: str, image_size: int,
+                        peak_tflops: float, tag: str = "") -> dict:
     # Scale FLOPs quadratically with resolution relative to 224 (convs dominate).
     gflops_img = RESNET50_TRAIN_GFLOPS_PER_IMG_224 * (image_size / 224.0) ** 2
     achieved_tflops = images_per_sec * gflops_img / 1e3
@@ -109,9 +156,9 @@ def _make_result(images_per_sec: float, platform: str, image_size: int,
     }
 
 
-def run_bench(batch_size: int = 256, steps: int = 60, warmup: int = 5,
-              image_size: int = 224, tag: str = "",
-              chunk: int = 10) -> dict:
+def run_resnet_bench(batch_size: int = 256, steps: int = 30, warmup: int = 5,
+                     image_size: int = 224, tag: str = "",
+                     chunk: int = 10) -> dict:
     import jax
     import jax.numpy as jnp
     import optax
@@ -121,8 +168,7 @@ def run_bench(batch_size: int = 256, steps: int = 60, warmup: int = 5,
     dev = jax.devices()[0]
     platform = dev.platform
     peak = _chip_peak_tflops(dev)
-    # CPU fallback runs f32: bf16 on CPU is software-emulated and ~10x
-    # slower, which would starve the fallback's already-small budget
+    # CPU fallback runs f32: bf16 on CPU is software-emulated and ~10x slower
     dtype = (jnp.float32 if os.environ.get("BENCH_DTYPE") == "float32"
              else jnp.bfloat16)
     model = ResNet50(num_classes=1000, dtype=dtype)
@@ -172,10 +218,16 @@ def run_bench(batch_size: int = 256, steps: int = 60, warmup: int = 5,
         float(loss)  # forces the chunk's step chain via dataflow dependency
         done += n
         dt = time.perf_counter() - t0
-        _publish(_make_result(batch_size * done / dt, platform, image_size,
-                              peak, tag))
+        _merge_key("resnet", _make_resnet_result(
+            batch_size * done / dt, platform, image_size, peak, tag))
     dt = time.perf_counter() - t0
-    return _make_result(batch_size * steps / dt, platform, image_size, peak, tag)
+    return _make_resnet_result(batch_size * steps / dt, platform, image_size,
+                               peak, tag)
+
+
+# ---------------------------------------------------------------------------
+# supervisor / inner split
+# ---------------------------------------------------------------------------
 
 
 def _outer() -> None:
@@ -213,8 +265,12 @@ def _outer() -> None:
     if result is None or result.get("value", 0) <= 0:
         # device backend unreachable: measure on CPU so a REAL number
         # lands, tagged by platform in the metric name + an explicit flag
-        cpu = attempt({"JAX_PLATFORMS": "cpu", "BENCH_STEPS": "6",
-                       "BENCH_BATCH_SIZE": "32", "BENCH_IMAGE_SIZE": "96",
+        cpu = attempt({"JAX_PLATFORMS": "cpu",
+                       "BENCH_GPT_CONFIG": "tiny",
+                       "BENCH_GPT_BS": "2", "BENCH_GPT_SEQ": "64",
+                       "BENCH_GPT_STEPS": "6",
+                       "BENCH_SKIP_RESNET": "1",
+                       "BENCH_SIMULATE_STALL": "",
                        "BENCH_DTYPE": "float32"},
                       0.35)
         if cpu is not None:
@@ -222,12 +278,18 @@ def _outer() -> None:
             result = cpu
     if result is None:
         result = {
-            "metric": "resnet50_train_images_per_sec_per_chip_timeout",
+            "metric": "gpt2_train_tokens_per_sec_per_chip_timeout",
             "value": 0.0,
-            "unit": "images/sec",
+            "unit": "tokens/sec",
             "vs_baseline": 0.0,
             "error": "backend stall on both device and cpu attempts",
         }
+    if result.get("tpu_stalled") or result.get("value", 0) <= 0:
+        # an environmental stall must never erase the round's evidence:
+        # attach the most recent real device measurement (VERDICT r3 #2)
+        last_good = _load_last_good()
+        if last_good is not None:
+            result["last_good_device_result"] = last_good
     print(json.dumps(result), flush=True)
 
 
@@ -236,6 +298,11 @@ def main() -> None:
 
     budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
     threading.Thread(target=_watchdog, args=(budget,), daemon=True).start()
+
+    if os.environ.get("BENCH_SIMULATE_STALL"):
+        # test hook: emulate the tunneled-device hang (round-2/3 failure
+        # mode) so the supervisor's fallback + last-good path is testable
+        time.sleep(budget + 3600)
 
     # The axon sitecustomize overrides jax_platforms at interpreter start, so
     # a JAX_PLATFORMS=cpu env request must be re-asserted in-process.
@@ -254,60 +321,78 @@ def main() -> None:
     except Exception:
         pass  # cache is an optimization; never fail the bench over it
 
-    kwargs = {}
-    if len(sys.argv) > 1:
-        kwargs["batch_size"] = int(sys.argv[1])
-    # env overrides (rehearsal on small machines / driver experiments)
-    for name, key in (("BENCH_BATCH_SIZE", "batch_size"),
-                      ("BENCH_STEPS", "steps"),
-                      ("BENCH_IMAGE_SIZE", "image_size")):
-        if os.environ.get(name):
-            kwargs[key] = int(os.environ[name])
+    from ray_tpu.benchmarks.gpt_mfu import run_gpt_bench
 
-    # Tiny probe first: lands a real measured number within ~a minute so a
-    # stall during the full-size run can still report throughput.
-    try:
-        probe = run_bench(batch_size=32, steps=6, warmup=2, image_size=96,
-                          tag="_probe", chunk=3)
-        _publish(probe)
-    except Exception:
-        probe = None
+    gpt_kwargs: dict = {}
+    for name, key in (("BENCH_GPT_BS", "batch_size"),
+                      ("BENCH_GPT_SEQ", "seq_len"),
+                      ("BENCH_GPT_STEPS", "steps")):
+        if os.environ.get(name):
+            gpt_kwargs[key] = int(os.environ[name])
+    if os.environ.get("BENCH_GPT_CONFIG"):
+        gpt_kwargs["config"] = os.environ["BENCH_GPT_CONFIG"]
 
     start = time.monotonic()
+    # Probe first (small batch, short sequence, few steps): lands a real
+    # measured number within ~a minute so a stall during the full-size run
+    # still reports throughput.
+    probe = None
+    if "config" not in gpt_kwargs:
+        try:
+            probe = run_gpt_bench(batch_size=4, seq_len=256, steps=4,
+                                  warmup=2, chunk=2)
+            probe["metric"] += "_probe"
+            _publish(probe)
+        except Exception:
+            probe = None
+
     try:
-        result = run_bench(**kwargs)
+        _publish(run_gpt_bench(publish=_publish, **gpt_kwargs))
     except Exception as e:
-        if probe is not None:
-            result = probe
-        else:
-            try:
-                # smallest fallback (memory-constrained or CPU-only envs)
-                result = run_bench(batch_size=32, steps=5, warmup=2,
-                                   image_size=96, tag="_fallback", chunk=5)
-            except Exception as e2:
-                # even a fast non-stall failure must land a JSON line
-                result = {
-                    "metric": "resnet50_train_images_per_sec_per_chip_error",
-                    "value": 0.0,
-                    "unit": "images/sec",
-                    "vs_baseline": 0.0,
-                    "error": f"{type(e).__name__}: {e}; fallback: "
-                             f"{type(e2).__name__}: {e2}"[:500],
-                }
-    _publish(result)
-    # Orchestration-overhead parity (the reference's REAL acceptance bar:
-    # <=~2.5% vs native, benchmarks.rst:56): measured in a CPU subprocess so
-    # it cannot disturb the chip result; skipped if the budget is tight.
-    def aux_bench(module: str, key: str, min_budget: float) -> None:
-        """Auxiliary CPU-subprocess metric: runs only with budget to spare
-        (so it cannot disturb the chip result) and merges ONE key into the
-        published result. Failures never lose the main number."""
+        if probe is None:
+            # no probe either: publish the error so the emitted line says
+            # WHY there is no number (with a probe, its result stands)
+            _publish({
+                "metric": "gpt2_train_tokens_per_sec_per_chip_error",
+                "value": 0.0,
+                "unit": "tokens/sec",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            })
+
+    def aux_bench(fn, key: str, min_budget: float) -> None:
+        """Secondary metric with whatever budget remains (so it cannot
+        disturb the headline). Failures never lose the main number."""
+        remaining = budget - (time.monotonic() - start) - 30.0
+        if remaining <= min_budget:
+            return
+        try:
+            _merge_key(key, fn(remaining))
+        except Exception:
+            pass
+
+    def _resnet(remaining: float) -> dict:
+        steps = 30 if remaining > 150 else 10
+        kwargs = {}
+        for name, k in (("BENCH_BATCH_SIZE", "batch_size"),
+                        ("BENCH_STEPS", "steps"),
+                        ("BENCH_IMAGE_SIZE", "image_size")):
+            if os.environ.get(name):
+                kwargs[k] = int(os.environ[name])
+        kwargs.setdefault("steps", steps)
+        return run_resnet_bench(**kwargs)
+
+    if not os.environ.get("BENCH_SKIP_RESNET"):
+        aux_bench(_resnet, "resnet", 75.0)
+
+    def aux_subprocess(module: str, key: str, min_budget: float) -> None:
+        """CPU-subprocess metric (orchestration parity numbers): runs only
+        with budget to spare and merges ONE key into the result."""
         remaining = budget - (time.monotonic() - start) - 30.0
         if remaining <= min_budget:
             return
         try:
             import subprocess
-            import sys
 
             env = dict(os.environ, JAX_PLATFORMS="cpu")
             r = subprocess.run(
@@ -316,17 +401,21 @@ def main() -> None:
             )
             if r.returncode == 0:
                 parsed = json.loads(r.stdout.strip().splitlines()[-1])
-                result[key] = parsed[key]
-                _publish(result)
+                _merge_key(key, parsed[key])
         except Exception:
             pass
 
     # the reference's REAL acceptance bar (<=~2.5% vs native,
     # benchmarks.rst:56), then the second north-star metric (BASELINE.json)
-    aux_bench("ray_tpu.benchmarks.trainer_overhead", "trainer_overhead_pct", 60.0)
-    aux_bench("ray_tpu.benchmarks.rllib_throughput", "ppo_env_steps_per_sec", 90.0)
+    aux_subprocess("ray_tpu.benchmarks.trainer_overhead",
+                   "trainer_overhead_pct", 60.0)
+    aux_subprocess("ray_tpu.benchmarks.rllib_throughput",
+                   "ppo_env_steps_per_sec", 90.0)
+
+    final = _current_result() or {}
+    _save_last_good(final)
     if _claim_print():
-        print(json.dumps(result), flush=True)
+        print(json.dumps(final), flush=True)
     os._exit(0)
 
 

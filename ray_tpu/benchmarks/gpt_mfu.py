@@ -1,0 +1,154 @@
+"""GPT-2 (125M) single-chip train-step benchmark — the headline metric.
+
+Transformers are the workload TPUs are bought for; this measures a jitted
+next-token training step (flash-attention Pallas kernel, bf16 activations,
+donated buffers) and reports tokens/sec + MFU.
+
+MFU convention: model FLOPs = 6 * n_params * tokens per train step (PaLM
+appendix-B style, attention excluded — conservative), divided by the chip's
+peak bf16 rate. The reference publishes no MFU (or any TPU number) for its
+trainers (doc/source/train/benchmarks.rst), so the bar here is the absolute
+one this repo sets for itself: >= 0.35 on a single chip.
+
+Runnable standalone: `python -m ray_tpu.benchmarks.gpt_mfu` prints one JSON
+line (used by bench.py as the headline entry).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import partial
+from typing import Callable
+
+
+def run_gpt_bench(
+    batch_size: int = 16,
+    seq_len: int = 1024,
+    steps: int = 40,
+    warmup: int = 4,
+    chunk: int = 8,
+    peak_tflops: float | None = None,
+    publish: Callable[[dict], None] | None = None,
+    config: str = "gpt2_small",
+) -> dict:
+    """Measure jitted GPT train-step throughput. `publish` receives partial
+    results after every chunk so a watchdog can report mid-run progress."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.gpt import (
+        GPTConfig, gpt_init, gpt_loss, gpt_num_params,
+    )
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    if peak_tflops is None:
+        peak_tflops = chip_peak_tflops(dev)
+
+    cfg = getattr(GPTConfig, config)() if config != "tiny" else GPTConfig.tiny()
+    if seq_len < cfg.max_seq_len:
+        # benching a shorter context: positional table slices down free
+        pass
+    n_params = gpt_num_params(cfg)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    opt_state = tx.init(params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(gpt_loss)(params, batch, cfg)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    batch = {
+        "tokens": jax.random.randint(
+            key, (batch_size, seq_len + 1), 0, cfg.vocab_size, jnp.int32
+        ),
+    }
+    tokens_per_step = batch_size * seq_len
+
+    def make_result(tps: float, tag: str = "") -> dict:
+        achieved = tps * 6.0 * n_params / 1e12
+        mfu = achieved / peak_tflops if peak_tflops else 0.0
+        return {
+            "metric": f"gpt2_125m_train_tokens_per_sec_per_chip_{platform}{tag}",
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
+            # no reference GPT/MFU number exists (BASELINE.md) — the bar is
+            # the self-set 35% MFU target, so vs_baseline = mfu / 0.35
+            "vs_baseline": round(mfu / 0.35, 3) if peak_tflops else 0.0,
+            "mfu": round(mfu, 4),
+            "achieved_tflops": round(achieved, 1),
+            "chip_peak_tflops": peak_tflops,
+            "n_params": n_params,
+            "batch_size": batch_size,
+            "seq_len": seq_len,
+        }
+
+    for _ in range(warmup):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+    # value fetch, not block_until_ready: the axon-tunneled platform treats
+    # block_until_ready as a no-op; only materializing forces execution
+    float(loss)
+
+    done = 0
+    t0 = time.perf_counter()
+    while done < steps:
+        n = min(chunk, steps - done)
+        for _ in range(n):
+            params, opt_state, loss = train_step(params, opt_state, batch)
+        float(loss)  # forces the chunk's chain via dataflow dependency
+        done += n
+        dt = time.perf_counter() - t0
+        if publish is not None:
+            publish(make_result(tokens_per_step * done / dt))
+    dt = time.perf_counter() - t0
+    return make_result(tokens_per_step * steps / dt)
+
+
+# Known per-chip peak bf16 TFLOP/s by device_kind substring (shared with
+# bench.py; ordering matters — first substring match wins).
+CHIP_PEAK_TFLOPS = [
+    ("v6", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),
+    ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+
+def chip_peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in CHIP_PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    if device.platform == "cpu":
+        return 0.5  # nominal; MFU on CPU is not meaningful
+    return 275.0  # assume v4-class if unknown
+
+
+def main() -> None:
+    # the axon sitecustomize overrides jax_platforms at interpreter start;
+    # a JAX_PLATFORMS=cpu request must be re-asserted in-process
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    kwargs: dict = {}
+    for name, key in (("BENCH_GPT_BS", "batch_size"),
+                      ("BENCH_GPT_SEQ", "seq_len"),
+                      ("BENCH_GPT_STEPS", "steps")):
+        if os.environ.get(name):
+            kwargs[key] = int(os.environ[name])
+    if os.environ.get("BENCH_GPT_CONFIG"):
+        kwargs["config"] = os.environ["BENCH_GPT_CONFIG"]
+    print(json.dumps(run_gpt_bench(**kwargs)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

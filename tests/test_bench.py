@@ -1,0 +1,69 @@
+"""bench.py hardening: a stalled device backend must still emit one honest
+JSON line AND carry the last good device measurement (VERDICT r3 #2 — two
+rounds of perf evidence were erased by end-of-round tunnel stalls)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(env_extra, timeout=240):
+    env = dict(os.environ, **env_extra)
+    env.pop("BENCH_INNER", None)
+    r = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=timeout, env=env)
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line: stdout={r.stdout!r} stderr={r.stderr[-500:]!r}"
+    return json.loads(lines[-1])
+
+
+@pytest.mark.slow
+def test_simulated_stall_falls_back_and_carries_last_good(tmp_path):
+    last_good = tmp_path / "last_good.json"
+    cached = {
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip_tpu",
+        "value": 12345.0, "unit": "tokens/sec", "mfu": 0.40,
+        "measured_at": "2026-07-30T00:00:00Z",
+    }
+    last_good.write_text(json.dumps(cached))
+    result = _run_bench({
+        "BENCH_SIMULATE_STALL": "1",          # device attempt hangs
+        "BENCH_BUDGET_S": "60",
+        "BENCH_LAST_GOOD_PATH": str(last_good),
+    })
+    # honest CPU fallback...
+    assert result["tpu_stalled"] is True
+    assert "_cpu" in result["metric"]
+    assert result["value"] > 0
+    # ...that did NOT erase the device evidence
+    assert result["last_good_device_result"]["value"] == 12345.0
+    # and the fallback must not overwrite the cache with a CPU number
+    assert json.loads(last_good.read_text())["value"] == 12345.0
+
+
+@pytest.mark.slow
+def test_cpu_inner_run_emits_gpt_headline(tmp_path):
+    """Direct inner run on CPU: headline metric is the GPT entry with an
+    mfu key (the driver's JSON contract)."""
+    env = {
+        "BENCH_INNER": "1", "JAX_PLATFORMS": "cpu",
+        "BENCH_GPT_CONFIG": "tiny", "BENCH_GPT_BS": "2",
+        "BENCH_GPT_SEQ": "64", "BENCH_GPT_STEPS": "6",
+        "BENCH_SKIP_RESNET": "1", "BENCH_BUDGET_S": "120",
+        "BENCH_LAST_GOOD_PATH": str(tmp_path / "lg.json"),
+    }
+    r = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=180, env=dict(os.environ, **env))
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON: {r.stdout!r} / {r.stderr[-500:]!r}"
+    result = json.loads(lines[-1])
+    assert result["unit"] == "tokens/sec"
+    assert "mfu" in result
+    assert result["value"] > 0
+    # CPU numbers never pollute the device cache
+    assert not (tmp_path / "lg.json").exists()
