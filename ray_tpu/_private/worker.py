@@ -683,6 +683,9 @@ class CoreWorker:
         try:
             method = getattr(self.actor_instance, spec["method_name"])
             args, kwargs = self._resolve_args(spec)
+            if spec.get("streaming"):
+                self._execute_streaming(spec, method, args, kwargs)
+                return
             result = method(*args, **kwargs)
             self._store_returns(spec, result)
         except Exception as e:  # noqa: BLE001
@@ -700,8 +703,14 @@ class CoreWorker:
         try:
             method = getattr(self.actor_instance, spec["method_name"])
             args, kwargs = self._resolve_args(spec)
-            result = method(*args, **kwargs)
-            self._store_returns(spec, result)
+            if spec.get("streaming"):
+                # _execute_streaming seals its own error marker, so the
+                # FINISHED/FAILED event below reports FINISHED; the consumer
+                # still sees the error through the completion marker
+                self._execute_streaming(spec, method, args, kwargs)
+            else:
+                result = method(*args, **kwargs)
+                self._store_returns(spec, result)
         except Exception as e:  # noqa: BLE001 — user code may raise anything
             failed = True
             self._store_error(spec, e)

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any
 
 from ray_tpu._private import task_spec as ts
+from ray_tpu._private.config import global_config as _global_config
 from ray_tpu._private.ids import ActorID
 from ray_tpu._private.worker import global_worker
 from ray_tpu.exceptions import ActorDiedError
@@ -18,7 +19,7 @@ from ray_tpu.exceptions import ActorDiedError
 
 class ActorClass:
     def __init__(self, cls, *, num_cpus=1, num_tpus=0, resources=None,
-                 max_restarts=0, name=None, lifetime=None,
+                 max_restarts=None, name=None, lifetime=None,
                  scheduling_strategy=None, runtime_env=None, max_concurrency=1):
         self._cls = cls
         self._class_name = cls.__name__
@@ -27,7 +28,7 @@ class ActorClass:
         self._resources.setdefault("CPU", float(num_cpus))
         if num_tpus:
             self._resources["TPU"] = float(num_tpus)
-        self._max_restarts = max_restarts
+        self._max_restarts = max_restarts  # None -> cluster default at .remote()
         self._name = name
         self._lifetime = lifetime
         self._scheduling_strategy = scheduling_strategy
@@ -68,13 +69,18 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> "ActorHandle":
         worker = global_worker()
         actor_id = ActorID.of(worker.job_id)
+        # cluster-wide default (config.max_actor_restarts_default) when the
+        # decorator didn't pin one; resolved at CREATION so a later
+        # init(_system_config=...) override reaches already-decorated classes
+        max_restarts = (self._max_restarts if self._max_restarts is not None
+                        else _global_config().max_actor_restarts_default)
         worker.gcs.call(
             "register_actor",
             {
                 "actor_id": actor_id.binary(),
                 "class_name": self._class_name,
                 "name": self._name,
-                "max_restarts": self._max_restarts,
+                "max_restarts": max_restarts,
             },
         )
         from ray_tpu.remote_function import _strategy_fields
@@ -91,7 +97,7 @@ class ActorClass:
             num_returns=1,
             resources=self._resources,
             actor_id=actor_id,
-            max_restarts=self._max_restarts,
+            max_restarts=max_restarts,
             placement=placement,
             scheduling=scheduling,
             runtime_env=self._runtime_env,
@@ -102,18 +108,21 @@ class ActorClass:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns=1):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
 
-    def options(self, *, num_returns: int = 1) -> "ActorMethod":
+    def options(self, *, num_returns=1) -> "ActorMethod":
         return ActorMethod(self._handle, self._method_name, num_returns)
 
     def remote(self, *args, **kwargs):
         worker = global_worker()
         h = self._handle
         raylet_addr = worker.actor_raylet_address(h._actor_id)
+        # generator methods stream exactly like normal tasks (reference:
+        # _raylet.pyx streaming generators work for actor tasks too)
+        streaming = self._num_returns == "streaming"
         spec = ts.make_task_spec(
             task_id=ts.TaskID.for_actor_task(h._actor_id),
             job_id=worker.job_id,
@@ -122,7 +131,8 @@ class ActorMethod:
             method_name=self._method_name,
             args=args,
             kwargs=kwargs,
-            num_returns=self._num_returns,
+            num_returns=1 if streaming else self._num_returns,
+            streaming=streaming,
             resources={},
             actor_id=h._actor_id,
             seqno=worker.next_actor_seqno(h._actor_id),
@@ -137,6 +147,10 @@ class ActorMethod:
         except ConnectionError:
             worker.invalidate_actor_cache(h._actor_id)
             raise ActorDiedError(h._actor_id.hex(), "raylet connection lost")
+        if streaming:
+            from ray_tpu._private.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0], spec)
         return refs[0] if self._num_returns == 1 else refs
 
 

@@ -10,6 +10,7 @@ from ray_tpu.serve.api import (
     delete,
     get_app_handle,
     get_deployment_handle,
+    grpc_port,
     run,
     shutdown,
     start,
@@ -21,10 +22,15 @@ from ray_tpu.serve.config import (
     AutoscalingConfig,
     BatchConfig,
     DeploymentConfig,
+    GrpcOptions,
     HTTPOptions,
 )
 from ray_tpu.serve.deployment import Application, Deployment, deployment
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 
 __all__ = [
     "Application",
@@ -34,12 +40,15 @@ __all__ = [
     "DeploymentConfig",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
+    "GrpcOptions",
     "HTTPOptions",
     "batch",
     "delete",
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "grpc_port",
     "multiplexed",
     "pad_to_bucket",
     "run",

@@ -11,13 +11,15 @@ import time
 
 import ray_tpu
 from ray_tpu.actor import ActorClass
-from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve.config import GrpcOptions, HTTPOptions
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.deployment import Application
 from ray_tpu.serve.handle import DeploymentHandle, _Router
+from ray_tpu.serve.grpc_proxy import GrpcProxy
 from ray_tpu.serve.proxy import HTTPProxy
 
 _proxy: HTTPProxy | None = None
+_grpc_proxy: GrpcProxy | None = None
 
 
 def _get_or_create_controller():
@@ -31,16 +33,24 @@ def _get_or_create_controller():
     return handle
 
 
-def start(http_options: HTTPOptions | dict | None = None) -> None:
-    """Start serve system actors (controller + HTTP proxy)
+def start(
+    http_options: HTTPOptions | dict | None = None,
+    grpc_options: GrpcOptions | dict | None = None,
+) -> None:
+    """Start serve system actors (controller + HTTP/gRPC proxies)
     (reference: serve.start)."""
-    global _proxy
+    global _proxy, _grpc_proxy
     _get_or_create_controller()
     if http_options is not None and _proxy is None:
         if isinstance(http_options, dict):
             http_options = HTTPOptions(**http_options)
         _proxy = HTTPProxy(http_options)
         _proxy.start()
+    if grpc_options is not None and _grpc_proxy is None:
+        if isinstance(grpc_options, dict):
+            grpc_options = GrpcOptions(**grpc_options)
+        _grpc_proxy = GrpcProxy(grpc_options)
+        _grpc_proxy.start()
 
 
 def run(
@@ -133,9 +143,15 @@ def get_deployment_handle(deployment_name: str, app_name: str = "default") -> De
     return DeploymentHandle(deployment_name, app_name)
 
 
+def grpc_port() -> int | None:
+    """Bound port of the gRPC ingress (None if not started); useful when
+    GrpcOptions.port=0 picked an ephemeral port."""
+    return _grpc_proxy.port if _grpc_proxy is not None else None
+
+
 def shutdown() -> None:
     """Tear down all serve state (reference: serve.shutdown)."""
-    global _proxy
+    global _proxy, _grpc_proxy
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
@@ -149,4 +165,7 @@ def shutdown() -> None:
     if _proxy is not None:
         _proxy.stop()
         _proxy = None
+    if _grpc_proxy is not None:
+        _grpc_proxy.stop()
+        _grpc_proxy = None
     _Router.reset_all()

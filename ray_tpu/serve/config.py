@@ -76,3 +76,13 @@ class DeploymentConfig:
 class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
+
+
+@dataclass
+class GrpcOptions:
+    """gRPC ingress (reference: serve gRPCOptions — grpc_servicer_functions
+    replaced by the generic byte-payload ServeAPI service, grpc_proxy.py).
+    port=0 binds an ephemeral port (exposed as GrpcProxy.port)."""
+
+    host: str = "127.0.0.1"
+    port: int = 9000

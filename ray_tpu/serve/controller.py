@@ -49,6 +49,7 @@ class _DeploymentState:
         self.target = self.config.target_num_replicas
         self.replicas: list[_ReplicaState] = []
         self.batch_configs: dict[str, dict] = {}
+        self.stream_methods: list[str] = []
         self.decider = (
             AutoscalingDecider(self.config.autoscaling_config)
             if self.config.autoscaling_config
@@ -102,6 +103,7 @@ class ServeController:
                     if self._same_spec(prev.spec, spec):
                         ds.replicas = prev.replicas  # adopt live replicas
                         ds.batch_configs = prev.batch_configs
+                        ds.stream_methods = prev.stream_methods
                         if prev.decider is not None and ds.decider is not None:
                             ds.decider = prev.decider
                     else:
@@ -162,6 +164,7 @@ class ServeController:
                         ],
                         "max_ongoing_requests": ds.config.max_ongoing_requests,
                         "batch_configs": ds.batch_configs,
+                        "stream_methods": ds.stream_methods,
                     }
                 out["apps"][app_name] = {
                     "ingress": app["ingress"],
@@ -248,15 +251,16 @@ class ServeController:
                 # not stall the reconcile loop (which also drives every other
                 # deployment's health checks)
                 if r.probe_ref is None:
-                    r.probe_ref = r.handle.batch_configs.remote()
+                    r.probe_ref = r.handle.replica_metadata.remote()
                     r.probe_deadline = time.monotonic() + 120.0
                 elif worker.store.status(r.probe_ref.object_id) != "missing":
                     # present OR evicted both mean the probe ran; get()
                     # reconstructs an evicted result from lineage
                     try:
-                        batch_cfgs = ray_tpu.get(r.probe_ref, timeout=30)
+                        meta = ray_tpu.get(r.probe_ref, timeout=30)
                         with self._lock:
-                            ds.batch_configs = batch_cfgs
+                            ds.batch_configs = meta["batch_configs"]
+                            ds.stream_methods = meta["stream_methods"]
                             r.state = "RUNNING"
                             ds.consecutive_start_failures = 0
                         changed = True

@@ -1,0 +1,171 @@
+"""gRPC ingress proxy — unary and server-streaming entry into Serve.
+
+Equivalent of the reference's gRPC proxy (reference:
+python/ray/serve/_private/proxy.py:975 gRPCProxy; serve.proto
+RayServeAPIService). Design difference: instead of protoc-generated user
+services, a single generic service with byte payloads — no codegen step,
+any gRPC client can call it:
+
+  service: ray_tpu.serve.ServeAPI
+    rpc Call   (bytes) returns (bytes)          — unary request/response
+    rpc Stream (bytes) returns (stream bytes)   — server streaming (LLM
+                                                  token decode)
+
+Request bytes are a JSON payload (or raw bytes if not JSON). Routing
+metadata keys (matching the reference's proxy metadata contract):
+  "application" — app name (default "default")
+  "method"      — deployment method (default "__call__")
+Response chunks: bytes pass through raw; any other value is JSON-encoded.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+SERVICE_NAME = "ray_tpu.serve.ServeAPI"
+CALL_METHOD = f"/{SERVICE_NAME}/Call"
+STREAM_METHOD = f"/{SERVICE_NAME}/Stream"
+
+_APP_CACHE_TTL_S = 2.0
+
+
+def _encode(value: Any) -> bytes:
+    if isinstance(value, bytes):
+        return value
+    return json.dumps({"result": value}).encode()
+
+
+def _decode(request: bytes) -> Any:
+    if not request:
+        return None
+    try:
+        return json.loads(request)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return request
+
+
+class GrpcProxy:
+    def __init__(self, options):
+        self.options = options
+        self._server = None
+        self.port: int | None = None
+        # app name -> (ingress deployment, fetched_at)
+        self._ingress_cache: dict[str, tuple[str, float]] = {}
+        self._cache_lock = threading.Lock()
+
+    # -- routing --
+
+    def _ingress_for(self, app_name: str) -> str:
+        now = time.monotonic()
+        with self._cache_lock:
+            hit = self._ingress_cache.get(app_name)
+            if hit is not None and now - hit[1] < _APP_CACHE_TTL_S:
+                return hit[0]
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        table = ray_tpu.get(controller.get_routing_table.remote(), timeout=30)
+        app = table["apps"].get(app_name)
+        if app is None:
+            raise KeyError(f"no serve application named {app_name!r}")
+        with self._cache_lock:
+            self._ingress_cache[app_name] = (app["ingress"], now)
+        return app["ingress"]
+
+    def _target(self, context) -> tuple[str, str]:
+        md = {k: v for k, v in (context.invocation_metadata() or ())}
+        return md.get("application", "default"), md.get("method", "__call__")
+
+    def _dispatch(self, request: bytes, context):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        app_name, method = self._target(context)
+        ingress = self._ingress_for(app_name)
+        handle = DeploymentHandle(ingress, app_name)
+        payload = _decode(request)
+        if method == "__call__":
+            return handle.remote(payload)
+        return getattr(handle, method).remote(payload)
+
+    # -- rpc handlers --
+
+    def _call(self, request: bytes, context) -> bytes:
+        import grpc
+
+        from ray_tpu.serve.handle import DeploymentResponseGenerator
+
+        try:
+            response = self._dispatch(request, context)
+            if isinstance(response, DeploymentResponseGenerator):
+                # unary call on a streaming method: drain into a list
+                return _encode(list(response))
+            return _encode(response.result(timeout=120))
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:  # noqa: BLE001 — surface to the client
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def _stream(self, request: bytes, context):
+        import grpc
+
+        from ray_tpu.serve.handle import DeploymentResponseGenerator
+
+        try:
+            response = self._dispatch(request, context)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            return
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return
+        try:
+            if isinstance(response, DeploymentResponseGenerator):
+                for chunk in response:
+                    yield _encode(chunk)
+            else:
+                yield _encode(response.result(timeout=120))
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    # -- server lifecycle --
+
+    def start(self) -> None:
+        import grpc
+        from concurrent import futures
+
+        identity = lambda x: x  # noqa: E731 — raw-bytes (de)serializer
+
+        handlers = {
+            "Call": grpc.unary_unary_rpc_method_handler(
+                self._call, request_deserializer=identity,
+                response_serializer=identity,
+            ),
+            "Stream": grpc.unary_stream_rpc_method_handler(
+                self._stream, request_deserializer=identity,
+                response_serializer=identity,
+            ),
+        }
+        generic = grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="serve-grpc"
+            )
+        )
+        self._server.add_generic_rpc_handlers((generic,))
+        self.port = self._server.add_insecure_port(
+            f"{self.options.host}:{self.options.port}"
+        )
+        if self.port == 0:
+            raise RuntimeError(
+                f"gRPC proxy failed to bind "
+                f"{self.options.host}:{self.options.port}"
+            )
+        self._server.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+            self._server = None

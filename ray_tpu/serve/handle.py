@@ -65,6 +65,43 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Result of calling a GENERATOR deployment method: iterate to receive
+    chunks as the replica produces them (reference: serve/handle.py
+    DeploymentResponseGenerator). Values (not refs) are yielded — the
+    handle resolves each chunk as it arrives."""
+
+    def __init__(self, ref_gen, on_done=None):
+        self._ref_gen = ref_gen
+        self._on_done = on_done
+        self._done = False
+        self._timeout = 120.0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        try:
+            ref = next(self._ref_gen)
+        except StopIteration:
+            self._mark_done()
+            raise
+        except BaseException:
+            self._mark_done()
+            raise
+        return ray_tpu.get(ref, timeout=self._timeout)
+
+    def _mark_done(self) -> None:
+        if not self._done:
+            self._done = True
+            if self._on_done:
+                self._on_done()
+
+    @property
+    def completed_ref(self):
+        return self._ref_gen.completed_ref
+
+
 class _Router:
     """Shared per-process router state: routing table cache + in-flight
     accounting + batchers. One per (app, deployment)."""
@@ -94,6 +131,7 @@ class _Router:
         self._lock = threading.Lock()
         self._replicas: list = []
         self._batch_configs: dict[str, dict] = {}
+        self._stream_methods: set[str] = set()
         self._max_ongoing = 8
         self._inflight: dict[bytes, int] = {}  # actor_id -> count
         self._outstanding: dict[bytes, bytes] = {}  # object_id -> actor_id
@@ -137,6 +175,7 @@ class _Router:
         with self._lock:
             self._replicas = dep["replicas"]
             self._batch_configs = dep["batch_configs"]
+            self._stream_methods = set(dep.get("stream_methods", ()))
             self._max_ongoing = dep["max_ongoing_requests"]
 
     # -- in-flight accounting --
@@ -198,9 +237,23 @@ class _Router:
                 f"takes exactly one positional argument per call, got "
                 f"args={len(args)} kwargs={sorted(kwargs)}"
             )
+        with self._lock:
+            is_stream = method_name in self._stream_methods
         replica = self._pick_replica(time.monotonic() + 30)
-        ref = replica.rt_call.remote(method_name, args, kwargs)
         aid = replica._actor_id.binary()
+        if is_stream:
+            # generator replica method: dispatch through the streaming
+            # call path so chunks seal (and are fetchable) as produced
+            gen = replica.rt_call_stream.options(
+                num_returns="streaming"
+            ).remote(method_name, args, kwargs)
+            oid = gen.completed_ref.object_id.binary()
+            with self._lock:
+                self._inflight[aid] = self._inflight.get(aid, 0) + 1
+                self._outstanding[oid] = aid
+            return DeploymentResponseGenerator(
+                gen, on_done=lambda: self._decrement(oid))
+        ref = replica.rt_call.remote(method_name, args, kwargs)
         oid = ref.object_id.binary()
         with self._lock:
             self._inflight[aid] = self._inflight.get(aid, 0) + 1

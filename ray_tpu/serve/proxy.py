@@ -15,7 +15,7 @@ from typing import Any
 
 import ray_tpu
 from ray_tpu.serve.config import HTTPOptions
-from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponseGenerator
 
 
 class HTTPProxy:
@@ -70,6 +70,68 @@ class HTTPProxy:
     def _serve_thread(self) -> None:
         from aiohttp import web
 
+        _END = object()
+
+        def _encode_chunk(chunk: Any, sse: bool) -> bytes:
+            if sse:
+                if isinstance(chunk, bytes):
+                    body = chunk.decode(errors="replace")
+                elif isinstance(chunk, str):
+                    body = chunk
+                else:
+                    body = json.dumps(chunk)
+                return b"data: " + body.encode() + b"\n\n"
+            if isinstance(chunk, bytes):
+                return chunk
+            if isinstance(chunk, str):
+                return chunk.encode()
+            return json.dumps(chunk).encode() + b"\n"
+
+        async def stream_response(request, response_gen) -> "web.StreamResponse":
+            """Pump chunks from the blocking DeploymentResponseGenerator
+            (iterated on an executor thread) out the socket as they arrive
+            — token streaming for LLM decode (reference:
+            serve/_private/proxy.py streaming ASGI responses). Server-sent
+            events when the client asks for text/event-stream; raw chunked
+            transfer otherwise."""
+            sse = "text/event-stream" in request.headers.get("Accept", "")
+            resp = web.StreamResponse()
+            resp.content_type = ("text/event-stream" if sse
+                                 else "application/octet-stream")
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+            loop = asyncio.get_event_loop()
+            queue: asyncio.Queue = asyncio.Queue(maxsize=16)
+
+            def pump():
+                try:
+                    for chunk in response_gen:
+                        f = asyncio.run_coroutine_threadsafe(
+                            queue.put(chunk), loop)
+                        f.result(timeout=120)
+                    asyncio.run_coroutine_threadsafe(
+                        queue.put(_END), loop).result(timeout=120)
+                except BaseException as e:  # noqa: BLE001 — ship to client
+                    try:
+                        asyncio.run_coroutine_threadsafe(
+                            queue.put(e), loop).result(timeout=120)
+                    except Exception:
+                        pass
+
+            threading.Thread(target=pump, daemon=True,
+                             name="serve-stream-pump").start()
+            while True:
+                item = await queue.get()
+                if item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    await resp.write(_encode_chunk(
+                        {"error": str(item)}, sse))
+                    break
+                await resp.write(_encode_chunk(item, sse))
+            await resp.write_eof()
+            return resp
+
         async def handler(request: web.Request) -> web.Response:
             target = self._match(request.path)
             if target is None:
@@ -87,10 +149,15 @@ class HTTPProxy:
                 payload = dict(request.query) or None
             # The whole call (routing included) runs in the executor: the
             # router does blocking controller RPCs and may sleep waiting for
-            # replicas, which must never stall the event loop.
+            # replicas, which must never stall the event loop. For generator
+            # ingresses the handle returns a response GENERATOR immediately
+            # (dispatch is non-blocking); chunks are pumped by stream_response.
             def call_blocking():
                 handle = DeploymentHandle(ingress, app_name)
-                return handle.remote(payload).result(timeout=120)
+                response = handle.remote(payload)
+                if isinstance(response, DeploymentResponseGenerator):
+                    return response
+                return response.result(timeout=120)
 
             try:
                 result = await asyncio.get_event_loop().run_in_executor(
@@ -98,6 +165,8 @@ class HTTPProxy:
                 )
             except Exception as e:  # noqa: BLE001 — surface to the client
                 return web.json_response({"error": str(e)}, status=500)
+            if isinstance(result, DeploymentResponseGenerator):
+                return await stream_response(request, result)
             if isinstance(result, (dict, list, str, int, float, bool, type(None))):
                 return web.json_response({"result": result})
             return web.json_response({"result": repr(result)})

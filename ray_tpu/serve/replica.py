@@ -176,6 +176,29 @@ class ReplicaActor:
                 out[name] = cfg.__dict__
         return out
 
+    def stream_methods(self) -> list[str]:
+        """Generator methods — routers dispatch these via the streaming
+        call path so chunks flow out as they are produced (reference:
+        serve/_private/replica.py streaming user callables)."""
+        if self._is_function:
+            return ["__call__"] if inspect.isgeneratorfunction(
+                self._instance) else []
+        out = []
+        for name, member in inspect.getmembers(self._instance, callable):
+            if name.startswith("_") and name != "__call__":
+                continue
+            fn = getattr(member, "__func__", member)
+            if inspect.isgeneratorfunction(fn):
+                out.append(name)
+        return out
+
+    def replica_metadata(self) -> dict:
+        """One readiness probe carrying everything the controller needs."""
+        return {
+            "batch_configs": self.batch_configs(),
+            "stream_methods": self.stream_methods(),
+        }
+
     # -- data surface --
 
     def rt_call(self, method_name: str, args: tuple, kwargs: dict):
@@ -186,6 +209,12 @@ class ReplicaActor:
             # the batch — ALL callers of this replica share one queue
             return queue.submit(args[0])
         return self._method(method_name)(*args, **kwargs)
+
+    def rt_call_stream(self, method_name: str, args: tuple, kwargs: dict):
+        """Streaming dispatch: a generator the router invokes with
+        num_returns='streaming' so every yielded chunk seals as its own
+        object the consumer can fetch before the method finishes."""
+        yield from self._method(method_name)(*args, **kwargs)
 
     def _batch_queue(self, method_name: str):
         q = self._batch_queues.get(method_name)
