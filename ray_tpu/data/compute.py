@@ -29,10 +29,11 @@ class ActorPoolStrategy:
     killed when the stage drains). Mirrors the reference's
     ``ActorPoolMapOperator`` scaling rule without its rate heuristics.
 
-    Resource note (same hazard as the reference's actor pools): each actor
-    RESERVES ``num_cpus`` for the stage's lifetime while upstream read/map
-    TASKS still need free slots — a pool sized to the whole cluster starves
-    its own input. Keep min_size below the cluster's CPU count.
+    Resource safety: the executor reserves one upstream task slot when the
+    pool feeds from a live stage (capping the pool below the cluster's CPU
+    count), and a pool whose configured minimum wouldn't leave that slot
+    free runs AFTER upstream materializes instead — a pool sized to the
+    whole cluster completes either way (executor.run_actor_stage).
     """
 
     min_size: int = 1

@@ -73,7 +73,7 @@ class _AllToAll(_Op):
 
     def __init__(self, map_fn, reduce_fn, n_out, name: str,
                  needs_bundles: bool = False, prepare=None,
-                 keep_empty: bool = False):
+                 keep_empty: bool = False, prepare_streaming=None):
         self.map_fn = map_fn
         self.reduce_fn = reduce_fn
         self.n_out = n_out
@@ -82,6 +82,11 @@ class _AllToAll(_Op):
         # of the input bundles are known (sort boundaries, repartition ranges)
         self.prepare = prepare
         self.keep_empty = keep_empty  # exact-n ops keep empty output blocks
+        # prepare_streaming() -> (map_fn, reduce_fn, n_out): available when
+        # the op needs NOTHING from the materialized input set — the
+        # executor then pipelines shuffle-maps against the live upstream
+        # instead of inserting a barrier (executor.run_all_to_all_pipelined)
+        self.prepare_streaming = prepare_streaming
 
 
 class _Union(_Op):
@@ -274,12 +279,17 @@ class Dataset:
         return self._with(_AllToAll(None, None, None, "repartition",
                                     prepare=prepare, keep_empty=True))
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
         """Global row shuffle as a 2-stage exchange (reference:
-        dataset.py random_shuffle → push_based_shuffle)."""
-
-        def prepare(bundles):
-            n_out = max(1, len(bundles))
+        dataset.py random_shuffle → push_based_shuffle). With an explicit
+        `num_blocks` the exchange PIPELINES against upstream (shuffle-map
+        tasks start while earlier stages still stream); otherwise the
+        output block count matches the input, which requires a barrier to
+        count the inputs first."""
+        def build(n_out):
+            # seed drawn at EXECUTION (build runs once per plan execution),
+            # so re-iterating an unseeded shuffle re-randomizes
             base = seed if seed is not None else np.random.randint(0, 2**31)
 
             def map_fn(table, n, idx):
@@ -296,8 +306,13 @@ class Dataset:
 
             return map_fn, reduce_fn, n_out
 
-        return self._with(_AllToAll(None, None, None, "random_shuffle",
-                                    prepare=prepare))
+        if num_blocks is not None:
+            return self._with(_AllToAll(
+                None, None, None, "random_shuffle",
+                prepare_streaming=lambda: build(num_blocks)))
+        return self._with(_AllToAll(
+            None, None, None, "random_shuffle",
+            prepare=lambda bundles: build(max(1, len(bundles)))))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         """Sample-partitioned distributed sort (reference: dataset.py sort →
@@ -414,7 +429,8 @@ class Dataset:
                             yield ref
 
                     stream = ex.run_actor_stage(
-                        srcs(), ts.dumps_function(op.fn), op.compute, ctx)
+                        srcs(), ts.dumps_function(op.fn), op.compute, ctx,
+                        upstream_live=True)
                     continue
                 if limit is not None:
                     # a map after a limit must see only the limited rows —
@@ -424,6 +440,14 @@ class Dataset:
             elif isinstance(op, _Limit):
                 limit = op.n if limit is None else min(limit, op.n)
             elif isinstance(op, _AllToAll):
+                if op.prepare_streaming is not None:
+                    # no barrier: shuffle-maps launch while upstream streams
+                    map_fn, reduce_fn, n_out = op.prepare_streaming()
+                    stream = ex.run_all_to_all_pipelined(
+                        flush(), ts.dumps_function(map_fn),
+                        ts.dumps_function(reduce_fn), n_out, ctx,
+                        keep_empty=op.keep_empty)
+                    continue
                 bundles = barrier()
                 map_fn, reduce_fn, n_out = op.prepare(bundles)
                 stream = iter(ex.run_all_to_all(

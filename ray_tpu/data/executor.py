@@ -194,19 +194,47 @@ def run_actor_stage(
     strategy,
     ctx: DataContext,
     limit_rows: Optional[int] = None,
+    upstream_live: bool = True,
 ) -> Iterator[RefBundle]:
     """Stream blocks through an autoscaling pool of `_PoolWorker` actors.
 
     Scale-up rule: if every live actor is saturated (max_tasks_in_flight
     queued) and input remains, add an actor, up to strategy.max_size.
     Output preserves submission order, same as run_oneone_stage.
+
+    Resource-aware admission (reference: streaming executor resource
+    budgets, _internal/execution/resource_manager.py): when the input is a
+    LIVE task stage (`upstream_live`), the pool may never occupy every CPU
+    — at least one is reserved so upstream tasks keep producing. A pool
+    whose configured minimum wouldn't fit that budget falls back to
+    materializing the upstream FIRST (barrier), then running at full
+    width: slower than pipelining, but it completes instead of
+    deadlocking pool-vs-upstream.
     """
     opts = dict(num_cpus=strategy.num_cpus)
     if strategy.resources:
         opts["resources"] = strategy.resources
     Worker = ray_tpu.remote(**opts)(_PoolWorker)
 
-    pool = [Worker.remote(factory_blob) for _ in range(strategy.min_size)]
+    per_actor = max(float(strategy.num_cpus), 1e-9)
+    try:
+        total_cpus = float(ray_tpu.cluster_resources().get("CPU", 4.0))
+    except Exception:
+        total_cpus = 4.0
+    pool_cap = max(1, int(total_cpus // per_actor))
+    if upstream_live:
+        live_cap = int((total_cpus - 1.0) // per_actor)
+        if live_cap < max(1, strategy.min_size):
+            # pool (at its configured minimum) + one upstream task slot
+            # don't fit: run upstream to completion first, then pool at
+            # full width — the barrier removes the CPU contention
+            sources = iter(list(sources))
+        else:
+            pool_cap = live_cap
+    max_pool = max(1, min(strategy.max_size, pool_cap))
+    min_pool = max(1, min(strategy.min_size, max_pool))
+
+    pool = [Worker.remote(factory_blob) for _ in range(min_pool)]
     load = {id(a): 0 for a in pool}  # actor -> queued block count
     by_id = {id(a): a for a in pool}
     inflight: dict = {}  # result_ref -> (seq, actor_id)
@@ -221,7 +249,7 @@ def run_actor_stage(
     def pick_actor():
         aid = min(load, key=lambda k: load[k])
         if load[aid] >= cap:
-            if len(pool) < strategy.max_size:
+            if len(pool) < max_pool:
                 a = Worker.remote(factory_blob)
                 pool.append(a)
                 load[id(a)] = 0
@@ -275,6 +303,62 @@ def run_actor_stage(
                 ray_tpu.kill(a)
             except Exception:
                 pass
+
+
+def run_all_to_all_pipelined(
+    bundles: Iterator[RefBundle],
+    map_blob: bytes,
+    reduce_blob: bytes,
+    n_out: int,
+    ctx: DataContext,
+    keep_empty: bool = False,
+) -> Iterator[RefBundle]:
+    """Pipelined exchange: shuffle-map tasks launch as upstream bundles
+    ARRIVE (overlapping the map phase with whatever still runs upstream),
+    and reduce outputs stream to the consumer in completion order. Usable
+    whenever n_out and map_fn don't depend on the materialized input set
+    (reference: streaming_executor.py — all-to-all operators participate in
+    the pipelined topology instead of acting as global barriers). The
+    reduce phase still requires every map output for its shard — that
+    barrier is inherent to the exchange, not the executor."""
+    window = _window_size(ctx)
+    map_out: List[List] = []  # [map_i][part_j] -> ref
+    inflight: list = []  # completion markers (part-0 refs) for backpressure
+    for i, (block_ref, _meta) in enumerate(bundles):
+        refs = _exec_shuffle_map.options(num_returns=n_out).remote(
+            map_blob, n_out, i, block_ref
+        )
+        if n_out == 1:
+            refs = [refs]
+        map_out.append(list(refs))
+        inflight.append(refs[0])
+        if len(inflight) >= window:
+            # bounded in-flight maps: wait for any to land before pulling
+            # more input (backpressure against a fast upstream)
+            ready, inflight = ray_tpu.wait(inflight, num_returns=1,
+                                           timeout=600)
+    n_in = len(map_out)
+    if n_in == 0:
+        return
+    pending: dict = {}  # meta_ref -> (j, block_ref)
+    for j in range(n_out):
+        parts = [map_out[i][j] for i in range(n_in)]
+        block_ref, meta_ref = _exec_reduce.options(num_returns=2).remote(
+            reduce_blob, *parts
+        )
+        pending[meta_ref] = (j, block_ref)
+    while pending:
+        ready, _ = ray_tpu.wait(list(pending.keys()), num_returns=1,
+                                timeout=600)
+        if not ready:
+            raise TimeoutError(
+                "all-to-all made no progress for 600s "
+                f"({len(pending)} reducers outstanding)")
+        for meta_ref in ready:
+            j, block_ref = pending.pop(meta_ref)
+            meta = ray_tpu.get(meta_ref, timeout=600)
+            if keep_empty or meta.num_rows > 0:
+                yield block_ref, meta
 
 
 def run_all_to_all(
