@@ -9,8 +9,11 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
+    PopulationBasedTrainingReplay,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
@@ -38,6 +41,9 @@ from ray_tpu.tune.tuner import (
 __all__ = [
     "SuggestAdapter",
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "PB2",
+    "PopulationBasedTrainingReplay",
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
     "FIFOScheduler",

@@ -16,6 +16,9 @@ from ray_tpu.tune.trial import Trial
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+# the trial is checkpointed and parked; the scheduler releases it later via
+# pending_actions() (synchronous band semantics need trials to WAIT)
+PAUSE = "PAUSE"
 
 
 class TrialScheduler:
@@ -28,11 +31,21 @@ class TrialScheduler:
             return -math.inf  # diverged trials rank worst in either mode
         return v if self.mode == "max" else -v
 
+    def on_trial_add(self, trial: Trial) -> None:
+        """Called when the controller creates the trial — BEFORE its first
+        report. Synchronous schedulers need the full population to know
+        when a barrier is complete."""
+
     def on_trial_result(self, trial: Trial, result: dict) -> str:
         return CONTINUE
 
     def on_trial_complete(self, trial: Trial) -> None:
         pass
+
+    def pending_actions(self) -> Dict[str, str]:
+        """trial_id -> "RESUME" | "STOP" for trials the scheduler parked
+        with PAUSE; drained by the controller once per step. Base: none."""
+        return {}
 
 
 class FIFOScheduler(TrialScheduler):
@@ -149,13 +162,17 @@ class PopulationBasedTraining(TrialScheduler):
                  hyperparam_mutations: dict | None = None,
                  quantile_fraction: float = 0.25,
                  resample_probability: float = 0.25,
-                 seed: int | None = None):
+                 seed: int | None = None,
+                 policy_log_dir: str | None = None):
         self.time_attr = time_attr
         self.interval = perturbation_interval
         self.mutations = hyperparam_mutations or {}
         self.quantile = quantile_fraction
         self.resample_prob = resample_probability
         self.rng = random.Random(seed)
+        # exploit decisions append (t, config) per trial here, replayable
+        # by PopulationBasedTrainingReplay (reference: pbt.py policy logs)
+        self.policy_log_dir = policy_log_dir
         self._last_perturb: Dict[str, float] = {}
         # trial_id -> (score, checkpoint_path, config) at last report
         self._state: Dict[str, tuple] = {}
@@ -187,7 +204,20 @@ class PopulationBasedTraining(TrialScheduler):
             return CONTINUE
         trial.config = self._explore(donor_cfg)
         trial.restore_path = donor_ckpt
+        self._log_policy(trial.trial_id, t, trial.config)
         return self.EXPLOIT
+
+    def _log_policy(self, trial_id: str, t: float, config: dict) -> None:
+        if not self.policy_log_dir:
+            return
+        import json as _json
+        import os as _os
+
+        _os.makedirs(self.policy_log_dir, exist_ok=True)
+        path = _os.path.join(self.policy_log_dir,
+                             f"pbt_policy_{trial_id}.jsonl")
+        with open(path, "a") as f:
+            f.write(_json.dumps({"t": t, "config": config}) + "\n")
 
     def _explore(self, config: dict) -> dict:
         new = dict(config)
@@ -210,6 +240,214 @@ class PopulationBasedTraining(TrialScheduler):
                 else:
                     new[key] = min(hi, max(lo, new[key] * self.rng.choice([0.8, 1.2])))
         return new
+
+
+class HyperBandScheduler(TrialScheduler):
+    """SYNCHRONOUS HyperBand (reference: tune/schedulers/hyperband.py:42
+    HyperBandScheduler — distinct from ASHA: successive-halving cuts happen
+    at a barrier). All live trials run to the current band milestone; a
+    trial that reaches it early is PAUSED (checkpointed + parked) until
+    every peer arrives, then the band keeps the top 1/reduction_factor by
+    milestone score, STOPs the rest, and resumes survivors toward the next
+    milestone (x reduction_factor). The barrier trades the stragglers'
+    wall-clock for exact same-budget comparisons — ASHA's frozen crossing
+    scores approximate this without waiting.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: float = 3,
+                 max_t: int = 81):
+        self.time_attr = time_attr
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self.milestone = float(grace_period)
+        self._scores: Dict[str, float] = {}  # tid -> score AT the milestone
+        self._live: set[str] = set()
+        self._paused: set[str] = set()
+        self._actions: Dict[str, str] = {}
+
+    def on_trial_add(self, trial: Trial) -> None:
+        # membership registers at trial CREATION so the first reporter
+        # can't trigger a solo "barrier" before peers ever report
+        self._live.add(trial.trial_id)
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        tid = trial.trial_id
+        self._live.add(tid)
+        t = result.get(self.time_attr, trial.iteration)
+        if t >= self.max_t:
+            self._live.discard(tid)
+            self._maybe_cut()
+            return STOP
+        if t < self.milestone:
+            return CONTINUE
+        self._scores.setdefault(tid, self._score(result))
+        if self._maybe_cut():
+            # the band just cut; this trial's own fate is in _actions
+            verdict = self._actions.pop(tid, "RESUME")
+            return STOP if verdict == "STOP" else CONTINUE
+        if trial.checkpoint_path is None:
+            # a pause would restart this trial from scratch (nothing to
+            # restore); keep it running — its milestone score is already
+            # frozen, so the barrier semantics are preserved
+            return CONTINUE
+        self._paused.add(tid)
+        return PAUSE
+
+    def on_trial_complete(self, trial: Trial) -> None:
+        self._live.discard(trial.trial_id)
+        self._maybe_cut()
+
+    def _maybe_cut(self) -> bool:
+        """When every live trial has a recorded score at the current
+        milestone, run the successive-halving cut."""
+        waiting = self._live - set(self._scores)
+        if waiting or not self._scores:
+            return False
+        ranked = sorted(self._scores.items(), key=lambda kv: -kv[1])
+        keep = max(1, int(math.ceil(len(ranked) / self.rf)))
+        for i, (tid, _score) in enumerate(ranked):
+            verdict = "RESUME" if i < keep else "STOP"
+            if tid in self._paused:
+                self._paused.discard(tid)
+                self._actions[tid] = verdict
+            else:
+                # the trial that triggered the cut is still running; its
+                # verdict is consumed by on_trial_result's return
+                self._actions[tid] = verdict
+            if verdict == "STOP":
+                self._live.discard(tid)
+        self._scores.clear()
+        self.milestone *= self.rf
+        return True
+
+    def pending_actions(self) -> Dict[str, str]:
+        out = {tid: v for tid, v in self._actions.items()}
+        self._actions.clear()
+        return out
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (reference: tune/schedulers/pb2.py —
+    PBT whose EXPLORE step replaces random perturbation with a GP-bandit
+    suggestion: fit a Gaussian process on (hyperparams -> score
+    improvement) observations and pick the UCB-maximizing candidate within
+    `hyperparam_bounds`). The GP here is a plain numpy RBF regressor — the
+    reference wraps GPy; the population sizes involved (tens of points)
+    don't need more.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 1.0,
+                 n_candidates: int = 64,
+                 seed: int | None = None):
+        super().__init__(
+            time_attr=time_attr,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations={},  # explore is GP-driven, not mutation
+            quantile_fraction=quantile_fraction,
+            seed=seed,
+        )
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds={key: [lo, hi]}")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        # observations: (config vector, score delta over one interval)
+        self._obs_x: List[List[float]] = []
+        self._obs_y: List[float] = []
+        self._prev_score: Dict[str, float] = {}
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        # record the improvement observation BEFORE the PBT boundary logic
+        score = self._score(result)
+        t = result.get(self.time_attr, trial.iteration)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last >= self.interval:
+            prev = self._prev_score.get(trial.trial_id)
+            if prev is not None:
+                self._obs_x.append(self._vec(trial.config))
+                self._obs_y.append(score - prev)
+            self._prev_score[trial.trial_id] = score
+        return super().on_trial_result(trial, result)
+
+    def _vec(self, config: dict) -> List[float]:
+        out = []
+        for k, (lo, hi) in sorted(self.bounds.items()):
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return out
+
+    def _explore(self, config: dict) -> dict:
+        import numpy as np
+
+        new = dict(config)
+        keys = sorted(self.bounds)
+        cand = np.array([
+            [self.rng.random() for _ in keys]
+            for _ in range(self.n_candidates)
+        ])
+        if len(self._obs_y) >= 3:
+            X = np.asarray(self._obs_x)
+            y = np.asarray(self._obs_y)
+            y_mean, y_std = y.mean(), y.std() or 1.0
+            yn = (y - y_mean) / y_std
+            ls, noise = 0.3, 1e-3
+
+            def rbf(a, b):
+                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                return np.exp(-d2 / (2 * ls * ls))
+
+            K = rbf(X, X) + noise * np.eye(len(X))
+            Ks = rbf(cand, X)
+            alpha = np.linalg.solve(K, yn)
+            mu = Ks @ alpha
+            v = np.linalg.solve(K, Ks.T)
+            var = np.clip(1.0 - (Ks * v.T).sum(-1), 1e-9, None)
+            ucb = mu + self.kappa * np.sqrt(var)
+            best = cand[int(np.argmax(ucb))]
+        else:
+            best = cand[0]  # cold start: random draw inside the bounds
+        for k, u in zip(keys, best):
+            lo, hi = self.bounds[k]
+            new[k] = lo + float(u) * (hi - lo)
+        return new
+
+
+class PopulationBasedTrainingReplay(TrialScheduler):
+    """Replay a recorded PBT schedule on a SINGLE trial (reference:
+    tune/schedulers/pbt.py:1035 PopulationBasedTrainingReplay): the policy
+    log written by PopulationBasedTraining(policy_log_dir=...) lists
+    (t, config) switch points; the replay applies each config at its
+    recorded time, restoring from the trial's own checkpoint — re-training
+    the winning lineage without re-running the population."""
+
+    def __init__(self, policy_log: str):
+        import json as _json
+
+        self.schedule: List[tuple] = []
+        with open(policy_log) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rec = _json.loads(line)
+                    self.schedule.append((float(rec["t"]), rec["config"]))
+        self.schedule.sort(key=lambda x: x[0])
+        self._next = 0
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        t = result.get("training_iteration", trial.iteration)
+        if self._next < len(self.schedule) and t >= self.schedule[self._next][0]:
+            _t, config = self.schedule[self._next]
+            self._next += 1
+            trial.config = dict(config)
+            trial.restore_path = trial.checkpoint_path  # own lineage
+            return PopulationBasedTraining.EXPLOIT
+        return CONTINUE
 
 
 # Public alias matching the reference's preferred name (reference:

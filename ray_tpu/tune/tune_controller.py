@@ -17,7 +17,9 @@ from typing import Callable, Optional
 import ray_tpu
 from ray_tpu._private import task_spec as ts
 from ray_tpu.tune import schedulers as sched_mod
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from ray_tpu.tune.schedulers import (
+    CONTINUE, PAUSE, STOP, FIFOScheduler, TrialScheduler,
+)
 from ray_tpu.tune.search import BasicVariantGenerator, Searcher
 from ray_tpu.tune.trial import (
     ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial,
@@ -131,6 +133,8 @@ class TuneController:
                 t.status = PENDING
                 t.restore_path = t.checkpoint_path
             self.trials.append(t)
+            if t.status in (RUNNING, PENDING, PAUSED):
+                self.scheduler.on_trial_add(t)
         return True
 
     # ---- event loop ----
@@ -171,13 +175,15 @@ class TuneController:
             if cfg is None:
                 self._searcher_done = True
                 break
-            self.trials.append(
-                Trial(config=cfg, experiment_dir=self.experiment_dir, trial_id=tid)
-            )
+            trial = Trial(config=cfg, experiment_dir=self.experiment_dir,
+                          trial_id=tid)
+            self.trials.append(trial)
+            self.scheduler.on_trial_add(trial)
 
     def step(self) -> bool:
         """One controller iteration; returns False when the experiment is done."""
         self._maybe_add_trials()
+        self._apply_pending_actions()
         running = [t for t in self.trials if t.status == RUNNING]
         # launch pending trials up to the concurrency cap
         for t in self.trials:
@@ -219,6 +225,13 @@ class TuneController:
                     trial.status = TERMINATED
                     self.searcher.on_trial_complete(trial.trial_id, metrics)
                     break
+                if decision == PAUSE:
+                    # park at the checkpoint; the scheduler resumes/stops it
+                    # later through pending_actions (synchronous bands)
+                    self._stop_actor(trial)
+                    trial.restore_path = trial.checkpoint_path
+                    trial.status = PAUSED
+                    break
                 if decision == sched_mod.PopulationBasedTraining.EXPLOIT:
                     # scheduler already rewrote trial.config/restore_path
                     self._stop_actor(trial)
@@ -239,9 +252,29 @@ class TuneController:
                     )
         if progressed:
             self.save_state()
-        return any(t.status in (PENDING, RUNNING) for t in self.trials) or (
-            not self._searcher_done
-        )
+        return any(
+            t.status in (PENDING, RUNNING, PAUSED) for t in self.trials
+        ) or (not self._searcher_done)
+
+    def _apply_pending_actions(self) -> None:
+        """Release trials the scheduler parked with PAUSE (sync HyperBand
+        resume/stop verdicts land here, once per step)."""
+        actions = self.scheduler.pending_actions()
+        if not actions:
+            return
+        by_id = {t.trial_id: t for t in self.trials}
+        for tid, verdict in actions.items():
+            trial = by_id.get(tid)
+            if trial is None or trial.status not in (PAUSED, RUNNING, PENDING):
+                continue
+            if verdict == "RESUME":
+                if trial.status == PAUSED:
+                    trial.status = PENDING
+            elif verdict == "STOP":
+                self._stop_actor(trial)
+                trial.status = TERMINATED
+                self.searcher.on_trial_complete(trial.trial_id,
+                                                trial.last_result)
 
     def _on_trial_error(self, trial: Trial, error: str) -> None:
         self._stop_actor(trial)
@@ -254,6 +287,9 @@ class TuneController:
             trial.status = ERROR
             trial.error = error
             self.searcher.on_trial_complete(trial.trial_id, error=True)
+            # a failed trial leaves any synchronous band it was part of —
+            # otherwise paused peers wait on it forever
+            self.scheduler.on_trial_complete(trial)
 
     def run(self) -> list[Trial]:
         while self.step():
