@@ -18,3 +18,8 @@ __all__ += ["APPO", "APPOConfig"]
 from ray_tpu.rllib.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
 
 __all__ += ["DDPG", "DDPGConfig", "TD3", "TD3Config"]
+
+from ray_tpu.rllib.algorithms.apex import ApexDQN, ApexDQNConfig
+from ray_tpu.rllib.algorithms.es import ES, ESConfig
+
+__all__ += ["ApexDQN", "ApexDQNConfig", "ES", "ESConfig"]

@@ -1,0 +1,183 @@
+"""ES — OpenAI Evolution Strategies (Salimans et al. 2017).
+
+Equivalent of the reference's ES (reference: rllib/algorithms/es/es.py —
+population of parameter perturbations evaluated by rollout-worker actors,
+antithetic sampling, centered-rank fitness shaping, shared noise via seeds
+so only integers cross the wire). Gradient-free: the "learner" is a plain
+SGD step on the rank-weighted perturbation directions, so there is no
+backprop and no value function — the architecture is embarrassingly
+parallel rollouts, which is exactly what the actor layer provides.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.rl_module import ActorCriticModule
+
+
+def _flatten(params: dict) -> tuple[np.ndarray, list]:
+    """Param tree -> flat vector + a spec to rebuild it."""
+    leaves, spec = [], []
+    for layer in params["policy"]:
+        for key in ("w", "b"):
+            arr = np.asarray(layer[key], np.float32)
+            spec.append((key, arr.shape))
+            leaves.append(arr.ravel())
+    return np.concatenate(leaves), spec
+
+
+def _unflatten(theta: np.ndarray, spec: list) -> dict:
+    layers, i, cur = [], 0, {}
+    for key, shape in spec:
+        n = int(np.prod(shape))
+        cur[key] = theta[i:i + n].reshape(shape)
+        i += n
+        if key == "b":
+            layers.append(cur)
+            cur = {}
+    return {"policy": layers}
+
+
+class ESWorker:
+    """Rollout-evaluation actor: receives theta + noise SEEDS (integers —
+    the noise is regenerated locally, the reference's shared-noise-table
+    trick without the table) and returns episodic returns for the
+    antithetic +/- perturbation pair of each seed."""
+
+    def __init__(self, env_spec, hidden, sigma: float, seed: int,
+                 episode_limit: int = 500):
+        self.env = make_env(env_spec)
+        obs0 = self.env.reset(seed=seed)
+        self.obs_dim = int(np.asarray(obs0).shape[0])
+        # probe action count: rllib Envs expose num_actions or action_dim
+        self.num_actions = int(getattr(self.env, "num_actions", 2))
+        self.module = ActorCriticModule(self.obs_dim, self.num_actions,
+                                        tuple(hidden))
+        self.sigma = sigma
+        self.episode_limit = episode_limit
+        self._spec = None
+
+    def _episode_return(self, theta: np.ndarray, spec, seed: int) -> float:
+        params = _unflatten(theta, spec)
+        obs = self.env.reset(seed=seed)
+        total = 0.0
+        for _ in range(self.episode_limit):
+            logits = ActorCriticModule._mlp_np(
+                params["policy"], np.asarray(obs, np.float32)[None])
+            action = int(np.argmax(logits[0]))
+            obs, r, term, trunc = self.env.step(action)
+            total += float(r)
+            if term or trunc:
+                break
+        return total
+
+    def evaluate(self, theta: np.ndarray, spec, seeds: list,
+                 eval_seed: int) -> list:
+        """[(ret_plus, ret_minus) per seed] — antithetic pairs."""
+        out = []
+        for s in seeds:
+            noise = np.random.default_rng(s).standard_normal(
+                theta.shape[0]).astype(np.float32)
+            out.append((
+                self._episode_return(theta + self.sigma * noise, spec,
+                                     eval_seed),
+                self._episode_return(theta - self.sigma * noise, spec,
+                                     eval_seed),
+            ))
+        return out
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_workers = 2
+        self.episodes_per_batch = 16  # perturbation pairs per iteration
+        self.sigma = 0.1
+        self.es_lr = 0.05
+        self.episode_limit = 500
+        self.algo_class = ES
+
+
+class ES(Algorithm):
+    """Driver holds theta; workers evaluate perturbations in parallel."""
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = make_env(cfg.env_spec)
+        obs0 = env.reset(seed=cfg.seed or 0)
+        obs_dim = int(np.asarray(obs0).shape[0])
+        num_actions = int(getattr(env, "num_actions", 2))
+        env.close()
+        self.module = ActorCriticModule(obs_dim, num_actions,
+                                        tuple(cfg.hidden))
+        p = self.module.init(cfg.seed or 0)
+        self.theta, self._spec = _flatten({"policy": p["pi"]})
+        Worker = ray_tpu.remote(num_cpus=1)(ESWorker)
+        self._workers = [
+            Worker.remote(cfg.env_spec, tuple(cfg.hidden), cfg.sigma,
+                          (cfg.seed or 0) + i, cfg.episode_limit)
+            for i in range(cfg.num_workers)
+        ]
+        self._rng = np.random.default_rng(cfg.seed or 0)
+        self._iter = 0
+
+    def _build_learner(self) -> None:  # pragma: no cover — gradient-free
+        pass
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        self._iter += 1
+        seeds = self._rng.integers(0, 2**31, cfg.episodes_per_batch)
+        chunks = np.array_split(seeds, len(self._workers))
+        eval_seed = int(self._rng.integers(0, 2**31))
+        refs = [
+            w.evaluate.remote(self.theta, self._spec, [int(s) for s in c],
+                              eval_seed)
+            for w, c in zip(self._workers, chunks) if len(c)
+        ]
+        pairs = [p for r in refs for p in ray_tpu.get(r, timeout=300)]
+        used_seeds = [int(s) for c in chunks for s in c][: len(pairs)]
+        rets = np.asarray(pairs, np.float32)  # [n, 2] (+, -)
+        # centered-rank fitness shaping over the flattened return set
+        flat = rets.ravel()
+        ranks = np.empty_like(flat)
+        ranks[np.argsort(flat)] = np.arange(flat.size, dtype=np.float32)
+        shaped = (ranks / (flat.size - 1) - 0.5).reshape(rets.shape)
+        grad = np.zeros_like(self.theta)
+        for (s_plus, s_minus), seed in zip(shaped, used_seeds):
+            noise = np.random.default_rng(seed).standard_normal(
+                self.theta.shape[0]).astype(np.float32)
+            grad += (s_plus - s_minus) * noise
+        grad /= (len(pairs) * cfg.sigma)
+        self.theta = self.theta + cfg.es_lr * grad
+        return {
+            "episode_return_mean": float(rets.mean()),
+            "episode_return_max": float(rets.max()),
+            "theta_norm": float(np.linalg.norm(self.theta)),
+            "training_iteration": self._iter,
+        }
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        params = _unflatten(self.theta, self._spec)
+        logits = ActorCriticModule._mlp_np(
+            params["policy"], np.asarray(obs, np.float32)[None])
+        return int(np.argmax(logits[0]))
+
+    def stop(self) -> None:
+        for w in getattr(self, "_workers", ()):
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        super().stop()
+
+    def train(self) -> dict:
+        # base train() would overwrite episode_return_mean with the (empty)
+        # runner-side return tracker; ES owns its own return metrics
+        metrics = self.training_step()
+        self.iteration += 1
+        metrics["training_iteration"] = self.iteration
+        return metrics

@@ -10,11 +10,14 @@ from ray_tpu.rllib.offline.io import (
     JsonWriter,
     compute_returns,
 )
+from ray_tpu.rllib.offline.cql import CQL, CQLConfig
 from ray_tpu.rllib.offline.marwil import BC, BCConfig, MARWIL, MARWILConfig
 
 __all__ = [
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
     "DatasetReader",
     "JsonReader",
     "JsonWriter",

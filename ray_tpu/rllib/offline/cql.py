@@ -1,0 +1,169 @@
+"""CQL — Conservative Q-Learning from offline experience files.
+
+Equivalent of the reference's CQL (reference: rllib/algorithms/cql/cql.py —
+SAC + conservative regularizer per Kumar et al. 2020). This is the
+DISCRETE-action variant (CQL(H) with the logsumexp regularizer over the
+full action set), trained from the same MARWIL/BC experience-file format
+(JsonReader rows), so a dataset recorded with config.offline_data(output=…)
+feeds it directly:
+
+    L = TD(double-Q) + cql_alpha * E[ logsumexp_a Q(s,a) - Q(s, a_data) ]
+
+The regularizer pushes down Q on out-of-distribution actions while holding
+up Q on dataset actions — the defining offline-RL correction the pure
+TD objective lacks.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.offline.io import DatasetReader, JsonReader
+from ray_tpu.rllib.rl_module import QModule
+
+
+def cql_loss(module, params, batch, config):
+    """Double-Q TD loss + conservative logsumexp penalty (pure jax)."""
+    import jax
+    import jax.numpy as jnp
+
+    q = module.forward(params, batch["obs"])
+    q_data = jnp.take_along_axis(q, batch["actions"][:, None], axis=-1)[:, 0]
+    q_next_online = module.forward(params, batch["next_obs"])
+    q_next_target = module.forward(batch["target_params"], batch["next_obs"])
+    best = jnp.argmax(q_next_online, axis=-1)
+    q_next = jnp.take_along_axis(q_next_target, best[:, None], axis=-1)[:, 0]
+    not_term = 1.0 - batch["terminateds"].astype(q.dtype)
+    target = batch["rewards"] + config["gamma"] * not_term * q_next
+    td = q_data - jax.lax.stop_gradient(target)
+    td_loss = jnp.mean(jnp.square(td))
+    # conservative term: logsumexp over ALL actions minus the dataset
+    # action's Q — zero iff the policy implied by Q stays on-distribution
+    cql_term = jnp.mean(jax.nn.logsumexp(q, axis=-1) - q_data)
+    total = td_loss + config["cql_alpha"] * cql_term
+    return total, {
+        "td_loss": td_loss,
+        "cql_gap": cql_term,
+        "q_data_mean": jnp.mean(q_data),
+    }
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.cql_alpha = 1.0
+        self.input_ = None  # path / JsonReader / DatasetReader / Dataset
+        self.observation_dim = None
+        self.num_actions = None
+        self.target_update_freq = 50  # gradient steps
+        self.algo_class = CQL
+
+    def offline_data(self, input_=None, cql_alpha=None) -> "CQLConfig":
+        if input_ is not None:
+            self.input_ = input_
+        if cql_alpha is not None:
+            self.cql_alpha = cql_alpha
+        return self
+
+    def environment(self, env=None, *, observation_dim=None,
+                    num_actions=None) -> "CQLConfig":
+        if env is not None:
+            self.env_spec = env
+        if observation_dim is not None:
+            self.observation_dim = observation_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+
+class CQL(Algorithm):
+    """Offline-only: `_setup` builds (s, a, r, s', term) transitions from
+    the episode files; `train()` runs minibatch TD + conservative epochs."""
+
+    def _setup(self) -> None:
+        cfg = self.config
+        reader = cfg.input_
+        if isinstance(reader, str):
+            reader = JsonReader(reader)
+        elif reader is not None and not hasattr(reader, "episodes"):
+            reader = DatasetReader(reader)
+        if reader is None:
+            raise ValueError("CQL requires config.offline_data(input_=...)")
+        obs, actions, rewards, next_obs, term = [], [], [], [], []
+        for ep in reader.episodes():
+            for i, row in enumerate(ep):
+                obs.append(row["obs"])
+                actions.append(row["action"])
+                rewards.append(row["reward"])
+                if i + 1 < len(ep):
+                    next_obs.append(ep[i + 1]["obs"])
+                else:
+                    next_obs.append(row["obs"])  # terminal: masked below
+                term.append(bool(row.get("terminated", row["done"])) or
+                            i + 1 == len(ep))
+        if not actions:
+            raise ValueError("offline input is empty")
+        self._obs = np.asarray(obs, np.float32)
+        self._actions = np.asarray(actions)
+        if self._actions.ndim != 1 or not np.all(
+                self._actions == np.round(self._actions)):
+            raise ValueError(
+                "discrete CQL requires scalar integer actions; got shape "
+                f"{self._actions.shape}")
+        self._actions = self._actions.astype(np.int32)
+        self._rewards = np.asarray(rewards, np.float32)
+        self._next_obs = np.asarray(next_obs, np.float32)
+        self._terminateds = np.asarray(term, np.bool_)
+        self.obs_dim = cfg.observation_dim or int(self._obs.shape[1])
+        self.num_actions = (cfg.num_actions
+                            or int(self._actions.max()) + 1)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._build_learner()
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        module = QModule(self.obs_dim, self.num_actions, cfg.hidden)
+        self.learner = Learner(
+            module,
+            cql_loss,
+            config={"gamma": cfg.gamma, "cql_alpha": cfg.cql_alpha},
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self._target_params = self.learner.get_weights_np()
+        self._grad_steps = 0
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n = len(self._actions)
+        mb = min(cfg.minibatch_size, n)
+        metrics_acc: dict[str, list[float]] = {}
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start:start + mb]
+                m = self.learner.update({
+                    "obs": self._obs[idx],
+                    "actions": self._actions[idx],
+                    "rewards": self._rewards[idx],
+                    "next_obs": self._next_obs[idx],
+                    "terminateds": self._terminateds[idx],
+                    "target_params": self._target_params,
+                })
+                self._grad_steps += 1
+                if self._grad_steps % cfg.target_update_freq == 0:
+                    self._target_params = self.learner.get_weights_np()
+                for k, v in m.items():
+                    metrics_acc.setdefault(k, []).append(v)
+        return {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+
+    def _sample_all(self):  # pragma: no cover — offline only
+        raise RuntimeError("offline algorithm does not sample")
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        w = self.learner.get_weights_np()
+        q = self.learner.module.forward_np(w, np.asarray(obs, np.float32)[None])
+        return int(np.argmax(q[0]))
