@@ -110,8 +110,8 @@ def init(
             if ignore_reinit_error:
                 return _node_handle
             raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
-        reset_config(_system_config)
-        from ray_tpu._private.node import start_head
+        cfg = reset_config(_system_config)
+        from ray_tpu._private.node import start_fake_tpu_hosts, start_head
 
         _node_handle = start_head(
             num_cpus=num_cpus,
@@ -120,6 +120,10 @@ def init(
             labels=labels,
             object_store_memory=object_store_memory,
         )
+        if cfg.fake_tpu_hosts > 0:
+            # fake multi-host TPU pod-slice topology (SURVEY §4.3 harness)
+            start_fake_tpu_hosts(_node_handle, cfg.fake_tpu_hosts,
+                                 cfg.tpu_chips_per_host_default)
         job_id = JobID(
             _node_handle.raylet.gcs.call("next_job_id")["job_id"]
         )

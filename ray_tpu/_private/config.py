@@ -50,18 +50,26 @@ class Config:
     # --- GCS ---
     gcs_heartbeat_interval_ms: int = 1000
     health_check_failure_threshold: int = 5
-    gcs_pubsub_poll_timeout_s: int = 30
     # --- actors ---
     actor_creation_timeout_s: int = 60
+    # default restart budget for actors created without max_restarts=
+    # (actor.py ActorMethod creation spec)
     max_actor_restarts_default: int = 0
     # --- TPU topology ---
+    # chips per fake host in the fake_tpu_hosts harness (node.py
+    # start_fake_tpu_hosts) — and the documented pod-slice host width
     tpu_chips_per_host_default: int = 4
-    ici_bandwidth_gbps: float = 400.0  # advisory, used by autoscaler packing
+    # slice-affinity cost model (scheduler.py schedule_bundles): TPU gangs
+    # are constrained to one ici-domain only while ICI beats DCN bandwidth
+    ici_bandwidth_gbps: float = 400.0
     # --- observability ---
     task_events_buffer_size: int = 10_000
+    # raylet node-gauge refresh cadence (raylet.py _metrics_report_loop)
     metrics_report_interval_ms: int = 2000
     # --- testing ---
-    fake_tpu_hosts: int = 0  # >0 enables the in-process fake multi-node harness
+    # >0: init() also starts this many fake TPU hosts (in-process raylets
+    # with TPU resources + pod-slice labels; node.py start_fake_tpu_hosts)
+    fake_tpu_hosts: int = 0
 
     def apply_overrides(self, system_config: dict[str, Any] | None = None) -> None:
         for f in fields(self):

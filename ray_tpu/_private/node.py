@@ -50,8 +50,23 @@ class NodeHandle:
         self.store_socket = store_socket
         self.session_dir = session_dir
         self.node_id = raylet.node_id
+        # fake multi-host TPU topology (config.fake_tpu_hosts): extra
+        # in-process raylets + their store daemons, torn down with the head
+        self.fake_nodes: list[tuple[Raylet, Any]] = []
 
     def shutdown(self) -> None:
+        for raylet, store_proc in self.fake_nodes:
+            try:
+                raylet.stop()
+            except Exception:
+                pass
+            if store_proc is not None:
+                try:
+                    store_proc.terminate()
+                    store_proc.wait(timeout=5)
+                except Exception:
+                    pass
+        self.fake_nodes = []
         self.raylet.stop()
         if self.gcs is not None:
             self.gcs.stop()
@@ -61,6 +76,32 @@ class NodeHandle:
                 self.store_proc.wait(timeout=5)
             except Exception:
                 pass
+
+
+def start_fake_tpu_hosts(head: NodeHandle, n_hosts: int,
+                         chips_per_host: int) -> None:
+    """SURVEY §4.3 fake-accelerator harness: present an n-host TPU pod
+    slice on one machine. Each fake host is a real in-process raylet with
+    its own store daemon, `TPU: chips_per_host` resources, and pod-slice
+    labels (one shared ici-domain — scheduler slice-affinity sees a real
+    topology). Enabled by config.fake_tpu_hosts > 0; chips per host come
+    from config.tpu_chips_per_host_default."""
+    cfg = global_config()
+    for i in range(n_hosts):
+        store_socket = os.path.join(head.session_dir, f"fake-tpu-{i}.sock")
+        store_proc = start_store(
+            store_socket, cfg.object_store_memory_bytes,
+            spill_dir=cfg.object_spilling_dir or None,
+        )
+        raylet = Raylet(
+            NodeID.from_random(),
+            head.gcs_address,
+            store_socket,
+            {"CPU": 1.0, "TPU": float(chips_per_host),
+             "memory": float(2 * 1024**3)},
+            {"ici-domain": "fake-slice-0", "fake-tpu-host": str(i)},
+        )
+        head.fake_nodes.append((raylet, store_proc))
 
 
 def _default_node_resources(

@@ -60,6 +60,33 @@ class WorkerHandle:
         self.oom_killed: tuple[str, bytes] | None = None
 
 
+_node_gauges_cache = None
+_node_gauges_lock = threading.Lock()
+
+
+def _node_gauges():
+    """Process-singleton node gauge families: in-process Cluster tests run
+    several raylets per process and prometheus_client rejects duplicate
+    registrations — nodes are distinguished by the `node` label instead."""
+    global _node_gauges_cache
+    with _node_gauges_lock:
+        if _node_gauges_cache is None:
+            try:
+                from ray_tpu.util.metrics import Gauge
+
+                _node_gauges_cache = (
+                    Gauge("ray_tpu_node_resource_available",
+                          "available per resource", ("node", "resource")),
+                    Gauge("ray_tpu_node_tasks_queued",
+                          "tasks waiting for dispatch", ("node",)),
+                    Gauge("ray_tpu_node_workers",
+                          "live worker processes", ("node",)),
+                )
+            except Exception:  # noqa: BLE001 — prometheus_client missing
+                _node_gauges_cache = False
+        return _node_gauges_cache or None
+
+
 class Raylet:
     # strict-mode wire validation against schema.SCHEMAS["raylet"] (rpc.py)
     schema_service = "raylet"
@@ -154,6 +181,7 @@ class Raylet:
             threading.Thread(target=self._dir_flush_loop, daemon=True, name="raylet-objdir"),
             threading.Thread(target=self._idle_reaper_loop, daemon=True, name="raylet-reaper"),
             threading.Thread(target=self._memory_monitor_loop, daemon=True, name="raylet-oom"),
+            threading.Thread(target=self._metrics_report_loop, daemon=True, name="raylet-metrics"),
         ]
         for t in self._threads:
             t.start()
@@ -252,6 +280,31 @@ class Raylet:
                 self._cluster_view[n["node_id"]] = n
             if "seq" in reply:
                 self._cluster_seq = reply["seq"]
+
+    def _metrics_report_loop(self) -> None:
+        """Periodic node-level gauge refresh at
+        config.metrics_report_interval_ms (reference: per-node metrics
+        agent push cadence, metrics_report_interval_ms in
+        ray_config_def.h). Gauges land in the in-process Prometheus
+        registry served by util.metrics.start_metrics_server."""
+        gauges = _node_gauges()
+        if gauges is None:  # prometheus_client unavailable: skip quietly
+            return
+        avail_g, queued_g, workers_g = gauges
+        interval = global_config().metrics_report_interval_ms / 1000.0
+        short_id = self.node_id.hex()[:12]
+        while not self._stopped.wait(interval):
+            try:
+                with self._lock:
+                    avail = dict(self.available)
+                    n_queued = len(self._queued)
+                    n_workers = len(self._all_workers)
+                for res, val in avail.items():
+                    avail_g.set(val, {"node": short_id, "resource": res})
+                queued_g.set(n_queued, {"node": short_id})
+                workers_g.set(n_workers, {"node": short_id})
+            except Exception:  # noqa: BLE001 — metrics must never kill a raylet
+                pass
 
     def _idle_reaper_loop(self) -> None:
         """Reap long-idle task workers down to one warm worker so an idle

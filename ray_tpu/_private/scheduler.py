@@ -186,7 +186,18 @@ def schedule_bundles(
     def tpu_domain(nid: bytes) -> str:
         return nodes[nid].get("labels", {}).get("ici-domain", "")
 
-    wants_tpu = any(b.get("TPU", 0) > 0 for b in bundles)
+    # Slice-affinity cost model: keeping a TPU gang on one ici-domain is
+    # worth constraining placement only while ICI is actually faster than
+    # the datacenter network (config.ici_bandwidth_gbps vs the ~4x25GbE
+    # DCN assumption). An operator benchmarking a DCN-as-fast-as-ICI
+    # topology sets the flag low and the affinity preference switches off.
+    from ray_tpu._private.config import global_config
+
+    _DCN_BANDWIDTH_GBPS = 100.0
+    wants_tpu = (
+        any(b.get("TPU", 0) > 0 for b in bundles)
+        and global_config().ici_bandwidth_gbps > _DCN_BANDWIDTH_GBPS
+    )
 
     if strategy == "STRICT_PACK":
         for nid in sorted(avail, key=lambda n: -sum(avail[n].values())):
