@@ -115,3 +115,44 @@ def test_resnet50_forward_backward(jax_cpu):
     assert any(
         not np.allclose(a, b) for a, b in zip(leaves_old, leaves_new)
     )
+
+
+def test_fold_batch_norm_matches_inference():
+    """BN folding: FoldedResNet(folded params) == ResNet eval mode, up to
+    dtype rounding. Run in f32 on a tiny variant so the equivalence check
+    is tight and fast."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.resnet import (
+        FoldedResNet, ResNet, fold_batch_norm, resnet_init,
+    )
+
+    # (2,1): stage-0 block 1 has NO downsample branch (identity residual),
+    # the other blocks do — both FoldedBottleneck paths run
+    model = ResNet(stage_sizes=(2, 1), num_classes=10, dtype=jnp.float32)
+    params, stats = resnet_init(jax.random.PRNGKey(0), model, 32)
+    # jitter EVERY param (incl. the zero-init third-BN scales and the
+    # zero biases — init values would make parts of the fold vacuous)...
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(7), len(leaves))
+    params = jax.tree.unflatten(treedef, [
+        l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)
+    ])
+    # ...and push non-trivial running statistics through
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    for _ in range(3):
+        _, mut = model.apply({"params": params, "batch_stats": stats}, x,
+                             train=True, mutable=["batch_stats"])
+        stats = mut["batch_stats"]
+
+    ref = model.apply({"params": params, "batch_stats": stats}, x,
+                      train=False)
+    folded_model = FoldedResNet(stage_sizes=(2, 1), num_classes=10,
+                                dtype=jnp.float32)
+    folded = fold_batch_norm(params, stats)
+    out = folded_model.apply({"params": folded}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
