@@ -23,3 +23,14 @@ from ray_tpu.rllib.algorithms.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 
 __all__ += ["ApexDQN", "ApexDQNConfig", "ES", "ESConfig"]
+
+from ray_tpu.rllib.algorithms.bandit import (
+    Bandit,
+    BanditConfig,
+    BanditLinTSConfig,
+    BanditLinUCBConfig,
+)
+from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
+
+__all__ += ["Bandit", "BanditConfig", "BanditLinTSConfig",
+            "BanditLinUCBConfig", "QMIX", "QMIXConfig"]

@@ -1,0 +1,129 @@
+"""Contextual bandits — LinUCB and Linear Thompson Sampling.
+
+Equivalent of the reference's bandit algorithms (reference:
+rllib/algorithms/bandit/bandit.py — BanditLinUCB, BanditLinTS over
+rllib/algorithms/bandit/bandit_torch_model.py's linear posteriors).
+Closed-form linear posteriors per arm (A = I*lambda + sum x x^T,
+b = sum r x): no gradient learner, no replay — the "training" is a
+rank-1 posterior update per observed (context, arm, reward), so these run
+entirely on the driver against a bandit-style env (reset -> context,
+step(arm) -> reward; episodes are length-1 by convention).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+
+
+class _LinearPosterior:
+    """Per-arm ridge posterior: A^-1 kept incrementally (Sherman-Morrison)."""
+
+    def __init__(self, dim: int, lam: float):
+        self.A_inv = np.eye(dim) / lam
+        self.b = np.zeros(dim)
+
+    def update(self, x: np.ndarray, r: float) -> None:
+        Ax = self.A_inv @ x
+        self.A_inv -= np.outer(Ax, Ax) / (1.0 + x @ Ax)
+        self.b += r * x
+
+    @property
+    def theta(self) -> np.ndarray:
+        return self.A_inv @ self.b
+
+
+class BanditConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.exploration = "ucb"  # "ucb" (LinUCB) | "ts" (Thompson)
+        self.ucb_alpha = 1.0
+        self.ts_scale = 1.0
+        self.ridge_lambda = 1.0
+        self.steps_per_iteration = 64
+        self.algo_class = Bandit
+
+
+class BanditLinUCBConfig(BanditConfig):
+    pass
+
+
+class BanditLinTSConfig(BanditConfig):
+    def __init__(self):
+        super().__init__()
+        self.exploration = "ts"
+
+
+class Bandit(Algorithm):
+    """Driver-side bandit loop (no EnvRunner actors: arms are evaluated
+    per-context and the posterior update is O(d^2) — actor round-trips
+    would dominate)."""
+
+    def _setup(self) -> None:
+        cfg = self.config
+        self.env = make_env(cfg.env_spec)
+        obs0 = np.asarray(self.env.reset(seed=cfg.seed or 0), np.float32)
+        self.obs_dim = int(obs0.shape[0])
+        self.num_actions = int(getattr(self.env, "num_actions", 2))
+        self._posteriors = [
+            _LinearPosterior(self.obs_dim, cfg.ridge_lambda)
+            for _ in range(self.num_actions)
+        ]
+        self._rng = np.random.default_rng(cfg.seed or 0)
+        self._ctx = obs0
+        self._lifetime_reward = 0.0
+        self._lifetime_steps = 0
+
+    def _build_learner(self) -> None:  # pragma: no cover — closed-form
+        pass
+
+    def _score_arms(self, x: np.ndarray) -> np.ndarray:
+        cfg = self.config
+        scores = np.empty(self.num_actions)
+        for a, post in enumerate(self._posteriors):
+            if cfg.exploration == "ts":
+                # sample theta ~ N(theta_hat, scale^2 * A^-1)
+                theta = self._rng.multivariate_normal(
+                    post.theta, cfg.ts_scale**2 * post.A_inv)
+                scores[a] = theta @ x
+            else:
+                var = float(x @ post.A_inv @ x)
+                scores[a] = post.theta @ x + cfg.ucb_alpha * np.sqrt(var)
+        return scores
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        """Greedy (exploitation-only) arm for evaluation."""
+        x = np.asarray(obs, np.float32)
+        return int(np.argmax([p.theta @ x for p in self._posteriors]))
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        total = 0.0
+        for _ in range(cfg.steps_per_iteration):
+            x = self._ctx
+            arm = int(np.argmax(self._score_arms(x)))
+            _obs, r, term, trunc = self.env.step(arm)
+            self._posteriors[arm].update(x, float(r))
+            total += float(r)
+            self._ctx = np.asarray(
+                self.env.reset() if (term or trunc) else _obs, np.float32)
+        self._lifetime_reward += total
+        self._lifetime_steps += cfg.steps_per_iteration
+        return {
+            "mean_reward": total / cfg.steps_per_iteration,
+            "lifetime_mean_reward":
+                self._lifetime_reward / self._lifetime_steps,
+        }
+
+    def train(self) -> dict:
+        metrics = self.training_step()
+        self.iteration += 1
+        metrics["training_iteration"] = self.iteration
+        return metrics
+
+    def stop(self) -> None:
+        try:
+            self.env.close()
+        except Exception:
+            pass

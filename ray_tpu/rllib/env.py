@@ -289,3 +289,80 @@ class VectorEnv:
         r, l = self.completed_returns, self.completed_lengths
         self.completed_returns, self.completed_lengths = [], []
         return r, l
+
+
+class CooperativeMatrixGame:
+    """One-step cooperative team game for value-factorization algorithms
+    (QMIX; reference: rllib/algorithms/qmix — evaluated on cooperative
+    team-reward tasks). TEAM-reward protocol, distinct from MultiAgentEnv's
+    per-agent dicts:
+
+        reset() -> {agent: obs}
+        step({agent: action}) -> ({agent: obs}, team_reward, term, trunc)
+        global_state() -> np.ndarray   (the mixer conditions on this)
+
+    Payoff: both pick 0 -> +8 (the coordinated optimum); both pick the
+    same nonzero arm -> +3; miscoordinate -> 0. Greedy independent
+    learners frequently settle on the safe +3; the mixed team value makes
+    the +8 joint action identifiable.
+    """
+
+    num_actions = 3
+    observation_dim = 1
+    agent_ids = ["a0", "a1"]
+
+    def __init__(self):
+        self._t = 0
+
+    def reset(self, seed: int | None = None) -> dict:
+        self._t = 0
+        return {a: np.ones(1, np.float32) for a in self.agent_ids}
+
+    def global_state(self) -> np.ndarray:
+        return np.ones(2, np.float32)
+
+    def step(self, actions: dict):
+        a0, a1 = actions["a0"], actions["a1"]
+        if a0 == a1 == 0:
+            reward = 8.0
+        elif a0 == a1:
+            reward = 3.0
+        else:
+            reward = 0.0
+        self._t += 1
+        obs = {a: np.ones(1, np.float32) for a in self.agent_ids}
+        return obs, reward, True, False
+
+    def close(self) -> None:
+        pass
+
+
+class ContextualBanditEnv(Env):
+    """Linear contextual bandit (reference: rllib/examples/env/bandit_envs —
+    the bandit algorithms' test surface). Each reset draws a context
+    x ~ U[0,1]^d; pulling arm a pays x[a] plus small noise, so the optimal
+    policy is argmax over context features and regret is measurable in
+    closed form. Episodes are length-1 (bandit convention)."""
+
+    num_actions = 3
+    observation_dim = 3
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._x = np.zeros(self.observation_dim, np.float32)
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._x = self._rng.random(self.observation_dim).astype(np.float32)
+        return self._x
+
+    def step(self, action: int):
+        reward = float(self._x[action]) + 0.01 * float(
+            self._rng.standard_normal())
+        # length-1 episode; next context arrives via the terminal reset
+        return self._x, reward, True, False
+
+
+_REGISTRY["CooperativeMatrixGame"] = CooperativeMatrixGame
+_REGISTRY["ContextualBandit"] = ContextualBanditEnv

@@ -31,3 +31,7 @@ from ray_tpu._private.usage_stats import record_library_usage as _rlu
 
 _rlu("train")
 del _rlu
+
+from ray_tpu.train.gbdt import GBDTTrainer, LightGBMTrainer, XGBoostTrainer
+
+__all__ += ["GBDTTrainer", "LightGBMTrainer", "XGBoostTrainer"]

@@ -4,6 +4,7 @@ from ray_tpu.util.placement_group import (
     remove_placement_group,
     slice_bundle,
 )
+from ray_tpu.util.dask_shim import ray_dask_get
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -15,5 +16,6 @@ __all__ = [
     "remove_placement_group",
     "slice_bundle",
     "NodeAffinitySchedulingStrategy",
+    "ray_dask_get",
     "PlacementGroupSchedulingStrategy",
 ]

@@ -1,0 +1,101 @@
+"""Dask-on-ray_tpu: execute dask task graphs on the distributed core.
+
+Equivalent of the reference's dask scheduler shim (reference:
+python/ray/util/dask/scheduler.py — ray_dask_get walks the dask graph,
+submits one ray task per graph node with upstream ObjectRefs as
+arguments, so the object store deduplicates shared intermediates and the
+cluster scheduler handles the DAG's parallelism).
+
+The dask GRAPH PROTOCOL is a plain dict — {key: computation} where a
+computation is a task tuple ``(callable, *args)``, a key reference, or a
+literal — so this shim needs no dask import to work: pass it to
+``dask.compute(..., scheduler=ray_dask_get)`` when dask is installed, or
+feed it protocol-shaped dicts directly (how the tests drive it).
+"""
+from __future__ import annotations
+
+from typing import Any, Hashable, Mapping, Sequence
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _exec_node(func, *args):
+    # upstream ObjectRefs in `args` arrive RESOLVED (task-arg semantics)
+    return func(*args)
+
+
+def _is_task(x: Any) -> bool:
+    return isinstance(x, tuple) and len(x) > 0 and callable(x[0])
+
+
+def ray_dask_get(dsk: Mapping[Hashable, Any], keys, **kwargs):
+    """Dask custom-scheduler entry point: materialize `keys` from graph
+    `dsk`, one ray_tpu task per graph node, dependencies passed as object
+    refs. Returns values in the same (possibly nested) structure dask
+    uses for `keys`."""
+    refs: dict[Hashable, Any] = {}
+
+    def submit(key: Hashable):
+        if key in refs:
+            return refs[key]
+        comp = dsk[key]
+        refs[key] = _build(comp)
+        return refs[key]
+
+    def _build(comp: Any):
+        """computation -> ObjectRef or literal."""
+        if _is_task(comp):
+            func, *args = comp
+            arg_refs = [_resolve_arg(a) for a in args]
+            return _exec_node.remote(func, *arg_refs)
+        return _resolve_arg(comp)
+
+    def _is_key(a: Any) -> bool:
+        # dask keys are strings or tuples like ("sum-<hash>", 0) — the
+        # TUPLE ITSELF is the key (literal tuples in dask graphs are
+        # expressed as (tuple, [items]), i.e. a task)
+        try:
+            return a in dsk
+        except TypeError:
+            return False
+
+    def _resolve_arg(a: Any):
+        if _is_task(a):
+            # nested task (dask inlines small expressions)
+            return _build(a)
+        if isinstance(a, (str, bytes, int, float, tuple)) and _is_key(a):
+            return submit(a)
+        if isinstance(a, list):
+            built = [_resolve_arg(x) for x in a]
+            if any(_has_ref(b) for b in built):
+                return _exec_node.remote(lambda *xs: list(xs), *built)
+            return built
+        return a
+
+    def _has_ref(x: Any) -> bool:
+        return isinstance(x, ray_tpu.ObjectRef)
+
+    def fetch(key_or_nested):
+        # dask's get(dsk, keys) convention: LISTS are structure to recurse
+        # into; tuples (and everything else) are keys
+        if isinstance(key_or_nested, list):
+            return [fetch(k) for k in key_or_nested]
+        out = submit(key_or_nested)
+        return ray_tpu.get(out, timeout=600) if _has_ref(out) else out
+
+    return fetch(keys)
+
+
+def enable_dask_on_ray_tpu() -> None:
+    """Install ray_dask_get as dask's default scheduler (no-op with a
+    clear error when dask isn't present — it is not baked into this
+    image)."""
+    try:
+        import dask
+    except ImportError as e:
+        raise ImportError(
+            "dask is not installed in this environment; pass "
+            "scheduler=ray_tpu.util.dask_shim.ray_dask_get explicitly "
+            "where dask is available") from e
+    dask.config.set(scheduler=ray_dask_get)

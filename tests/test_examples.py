@@ -37,3 +37,10 @@ def test_example_train_gpt_mesh(ray_start, jax_cpu):
     result = _load("train_gpt_mesh").main()
     assert result.error is None
     assert result.metrics["loss"] > 0
+
+
+def test_example_serve_streaming_llm(ray_start):
+    tokens, sse, rpc = _load("serve_streaming_llm").main()
+    assert tokens == ["echo", "hello"]
+    assert sse == ["echo", "world"]
+    assert rpc == ["echo", "grpc"]
