@@ -36,11 +36,13 @@ _KNOWN = {"env_vars", "working_dir", "py_modules", "pip"}
 def validate_runtime_env(env: dict | None) -> None:
     if not env:
         return
-    unknown = set(env) - _KNOWN
+    from ray_tpu._private import runtime_env_plugin as rep
+
+    unknown = set(env) - _KNOWN - rep.plugin_names()
     if unknown:
         raise ValueError(
             f"unsupported runtime_env fields {sorted(unknown)}; supported: "
-            f"{sorted(_KNOWN)}"
+            f"{sorted(_KNOWN | rep.plugin_names())}"
         )
     wd = env.get("working_dir")
     if wd is not None and not os.path.isdir(wd):
@@ -52,6 +54,10 @@ def validate_runtime_env(env: dict | None) -> None:
                 raise ValueError('runtime_env pip dict needs a "packages" key')
         elif not isinstance(pip, (list, tuple)):
             raise ValueError("runtime_env pip must be a list or dict")
+    for key in set(env) - _KNOWN:
+        plugin = rep.get_plugin(key)
+        if plugin is not None:
+            plugin.validate(env[key])
 
 
 # ---------------------------------------------------------------------------
@@ -198,24 +204,43 @@ def applied_runtime_env(env: dict | None, *, permanent: bool = False):
     saved_env: dict[str, str | None] = {}
     saved_cwd = None
     saved_path = None
-    for k, v in (env.get("env_vars") or {}).items():
-        saved_env[k] = os.environ.get(k)
-        os.environ[k] = str(v)
-    wd = env.get("working_dir")
-    if wd:
-        saved_cwd = os.getcwd()
-        os.chdir(wd)
-    mods = list(env.get("py_modules") or [])
-    if env.get("pip"):
-        mods.append(ensure_pip_env(env["pip"]))
-    if mods:
-        saved_path = list(sys.path)
-        for m in reversed(mods):
-            sys.path.insert(0, m)
+    plugin_restores: list = []
+    # EVERY mutation happens inside the try: a failure mid-setup (a pip
+    # install, a plugin create) must still restore the mutations already
+    # made — a pooled worker keeps running other tasks afterwards
     try:
+        for k, v in (env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        wd = env.get("working_dir")
+        if wd:
+            saved_cwd = os.getcwd()
+            os.chdir(wd)
+        mods = list(env.get("py_modules") or [])
+        if env.get("pip"):
+            mods.append(ensure_pip_env(env["pip"]))
+        if mods:
+            saved_path = list(sys.path)
+            for m in reversed(mods):
+                sys.path.insert(0, m)
+        # plugin-owned keys (conda/container/custom — runtime_env_plugin.py):
+        # create-once resources + per-task process mutation with undo
+        from ray_tpu._private import runtime_env_plugin as rep
+
+        for key in sorted(
+                set(env) - _KNOWN,
+                key=lambda k: getattr(rep.get_plugin(k), "priority", 10)):
+            restore = rep.apply_plugin(key, env[key])
+            if restore is not None:
+                plugin_restores.append(restore)
         yield
     finally:
         if not permanent:
+            for restore in reversed(plugin_restores):
+                try:
+                    restore()
+                except Exception:  # noqa: BLE001 — restore is best-effort
+                    pass
             for k, old in saved_env.items():
                 if old is None:
                     os.environ.pop(k, None)

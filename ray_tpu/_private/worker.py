@@ -457,6 +457,13 @@ class CoreWorker:
 
     def submit_actor_task(self, spec: dict, raylet_address: str | None) -> list[ObjectRef]:
         refs = [ObjectRef(o) for o in ts.return_object_ids(spec)]
+        # actor tasks get the same SUBMITTED timeline event as normal tasks
+        # (reference: task_events cover every task type; without this the
+        # state API showed actor calls springing into RUNNING from nowhere)
+        self.task_events.record(
+            task_id=spec["task_id"], job_id=spec["job_id"], name=spec["name"],
+            event="SUBMITTED", task_type=spec["type"],
+        )
         with self._ref_lock:
             self._owned.update(r.object_id.binary() for r in refs)
         self._add_dep_holds(spec["task_id"], list(spec["arg_deps"]))

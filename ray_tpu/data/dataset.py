@@ -727,11 +727,16 @@ class GroupedData:
 
     def _agg(self, col_fns: Dict[str, tuple]) -> Dataset:
         """col_fns: out_col -> (in_col, partial, combine) where partial
-        aggregates within a block and combine merges partials."""
-        key = self._key
+        aggregates within a block and combine merges partials.
 
-        def prepare(bundles):
-            n_out = max(1, min(len(bundles), 8))
+        Hash partitioning depends on nothing from the materialized input
+        set, so the exchange PIPELINES: partial-aggregate maps launch as
+        upstream blocks arrive (executor.run_all_to_all_pipelined) with a
+        fixed reducer fan-out."""
+        key = self._key
+        n_out_fixed = 8  # hash-partition fan-out; empties are filtered
+
+        def build(n_out):
 
             def map_fn(table, n, idx):
                 # partial aggregate per key within this block, then route by
@@ -768,8 +773,9 @@ class GroupedData:
 
             return map_fn, reduce_fn, n_out
 
-        return self._ds._with(_AllToAll(None, None, None, "groupby",
-                                        prepare=prepare))
+        return self._ds._with(_AllToAll(
+            None, None, None, "groupby",
+            prepare_streaming=lambda: build(n_out_fixed)))
 
     def count(self) -> Dataset:
         return self._agg({"count()": (self._key, lambda s: len(s),

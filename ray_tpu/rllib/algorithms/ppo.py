@@ -81,19 +81,37 @@ class PPOConfig(AlgorithmConfig):
         self.vf_loss_coeff = 0.5
         self.entropy_coeff = 0.01
         self.gae_lambda = 0.95
+        # frame_shape=(H, W, C) switches the policy/value net to the conv
+        # trunk (ConvActorCriticModule) — the Atari-class configuration
+        # (reference: VisionNetwork selection for image observation spaces)
+        self.frame_shape = None
         self.algo_class = PPO
+
+
+def _ac_module_factory(hidden, frame_shape):
+    """Module factory shared by runner actors and the learner: conv trunk
+    for frame observations (config.hidden's LAST width sizes the dense
+    layer after the convs), MLP otherwise."""
+    if frame_shape is not None:
+        from ray_tpu.rllib.rl_module import ConvActorCriticModule
+
+        dense = int(hidden[-1]) if hidden else 128
+        return lambda obs_dim, n_act: ConvActorCriticModule(
+            obs_dim, n_act, frame_shape, hidden=dense)
+    return lambda obs_dim, n_act: ActorCriticModule(obs_dim, n_act, hidden)
 
 
 class PPO(Algorithm):
     runner_mode = "actor_critic"
 
     def _runner_factory(self):
-        hidden = tuple(self.config.hidden)
-        return lambda obs_dim, n_act: ActorCriticModule(obs_dim, n_act, hidden)
+        return _ac_module_factory(tuple(self.config.hidden),
+                                  self.config.frame_shape)
 
     def _build_learner(self) -> None:
         cfg = self.config
-        module = ActorCriticModule(self.obs_dim, self.num_actions, cfg.hidden)
+        module = _ac_module_factory(tuple(cfg.hidden), cfg.frame_shape)(
+            self.obs_dim, self.num_actions)
         self.learner = Learner(
             module,
             ppo_loss,

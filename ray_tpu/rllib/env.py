@@ -366,3 +366,78 @@ class ContextualBanditEnv(Env):
 
 _REGISTRY["CooperativeMatrixGame"] = CooperativeMatrixGame
 _REGISTRY["ContextualBandit"] = ContextualBanditEnv
+
+
+class MiniBreakout(Env):
+    """MinAtar-style Breakout (10x10x4 binary frames) — the Atari-class
+    conv-policy workload (reference: RLlib's Atari benchmarks; MinAtar,
+    Young & Tian 2019, is the accepted small-scale stand-in: same visual
+    structure — paddle/ball/trail/brick CHANNELS — at 1/600th the pixels).
+    Observation is the flattened [10, 10, 4] frame (conv modules reshape);
+    reward +1 per brick, episode ends on ball loss or board clear."""
+
+    H = W = 10
+    num_actions = 3  # left / stay / right
+    observation_dim = H * W * 4
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self.max_episode_steps = 500
+        self.reset()
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._paddle = self.W // 2
+        self._ball = [self.H - 4, int(self._rng.integers(1, self.W - 1))]
+        self._dball = [1, 1 if self._rng.random() < 0.5 else -1]
+        self._bricks = np.zeros((self.H, self.W), np.bool_)
+        self._bricks[1:4, :] = True
+        self._trail = list(self._ball)
+        self._steps = 0
+        return self._obs()
+
+    def _obs(self) -> np.ndarray:
+        f = np.zeros((self.H, self.W, 4), np.float32)
+        f[self.H - 1, self._paddle, 0] = 1.0           # paddle
+        f[self._ball[0], self._ball[1], 1] = 1.0       # ball
+        f[self._trail[0], self._trail[1], 2] = 1.0     # last ball position
+        f[:, :, 3] = self._bricks                      # bricks
+        return f.reshape(-1)
+
+    def step(self, action: int):
+        self._steps += 1
+        self._paddle = int(np.clip(self._paddle + (action - 1), 0, self.W - 1))
+        self._trail = list(self._ball)
+        r, c = self._ball
+        dr, dc = self._dball
+        nr, nc = r + dr, c + dc
+        reward = 0.0
+        if nc < 0 or nc >= self.W:           # side wall
+            dc = -dc
+            nc = c + dc
+        if nr < 0:                           # ceiling
+            dr = -dr
+            nr = r + dr
+        if 0 <= nr < self.H and self._bricks[nr, nc]:
+            self._bricks[nr, nc] = False     # brick: bounce + score
+            reward = 1.0
+            dr = -dr
+            nr = r + dr
+        terminated = False
+        if nr >= self.H - 1:                 # paddle row
+            if abs(nc - self._paddle) <= 1:
+                dr = -1
+                nr = self.H - 2
+            else:
+                terminated = True            # ball lost
+        if not self._bricks.any():
+            terminated = True                # board cleared
+            reward += 5.0
+        self._ball = [int(np.clip(nr, 0, self.H - 1)), int(nc)]
+        self._dball = [dr, dc]
+        truncated = self._steps >= self.max_episode_steps
+        return self._obs(), reward, terminated, truncated
+
+
+_REGISTRY["MiniBreakout"] = MiniBreakout

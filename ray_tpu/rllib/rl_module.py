@@ -210,3 +210,97 @@ class DeterministicPolicyModule:
 
         x = jnp.concatenate([obs, actions], axis=-1)
         return _mlp_jax(params[head], x)[:, 0]
+
+
+def _conv2d_np(x: np.ndarray, w: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """SAME-padded 3x3 conv, NHWC, via im2col — the EnvRunner numpy path
+    for conv policies (rollout batches are small; matmul via BLAS)."""
+    B, H, W, C = x.shape
+    kh, kw, _, F = w.shape
+    xp = np.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    cols = np.empty((B, H, W, kh * kw * C), x.dtype)
+    k = 0
+    for dy in range(kh):
+        for dx in range(kw):
+            cols[..., k * C:(k + 1) * C] = xp[:, dy:dy + H, dx:dx + W, :]
+            k += 1
+    return cols.reshape(B * H * W, -1) @ w.reshape(-1, F) + b
+
+
+class ConvActorCriticModule:
+    """Conv policy/value net for frame-observation envs (the Atari-class
+    workload; reference: rllib VisionNetwork models/catalog defaults for
+    image spaces). Obs arrive FLATTENED from the runner ([B, H*W*C]); the
+    module owns the reshape. Trunk: two SAME 3x3 convs (relu) -> flatten
+    -> dense(128, tanh); separate pi/vf heads. The jax path uses
+    lax.conv_general_dilated NHWC (MXU-friendly layout on TPU)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 frame_shape: Sequence[int] = (10, 10, 4),
+                 channels: Sequence[int] = (16, 32), hidden: int = 128):
+        H, W, C = frame_shape
+        if H * W * C != obs_dim:
+            raise ValueError(f"frame_shape {frame_shape} != obs_dim {obs_dim}")
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.frame_shape = tuple(frame_shape)
+        self.channels = tuple(channels)
+        self.hidden = hidden
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        H, W, C = self.frame_shape
+        params: dict = {"conv": []}
+        c_in = C
+        for c_out in self.channels:
+            fan_in = 9 * c_in
+            params["conv"].append({
+                "w": (rng.standard_normal((3, 3, c_in, c_out)) *
+                      np.sqrt(2.0 / fan_in)).astype(np.float32),
+                "b": np.zeros(c_out, np.float32),
+            })
+            c_in = c_out
+        flat = H * W * c_in
+        params["trunk"] = [_init_linear(rng, flat, self.hidden, np.sqrt(2))]
+        params["pi"] = [_init_linear(rng, self.hidden, self.num_actions, 0.01)]
+        params["vf"] = [_init_linear(rng, self.hidden, 1, 1.0)]
+        return params
+
+    # -- numpy path (EnvRunner rollouts) --
+
+    def _trunk_np(self, params: dict, obs: np.ndarray) -> np.ndarray:
+        B = obs.shape[0]
+        x = obs.reshape(B, *self.frame_shape)
+        for layer in params["conv"]:
+            x = _conv2d_np(x, layer["w"], layer["b"])
+            x = np.maximum(x, 0.0).reshape(B, *self.frame_shape[:2], -1)
+        h = x.reshape(B, -1)
+        t = params["trunk"][0]
+        return np.tanh(h @ t["w"] + t["b"])
+
+    def forward_np(self, params: dict, obs: np.ndarray):
+        h = self._trunk_np(params, obs)
+        pi, vf = params["pi"][0], params["vf"][0]
+        return h @ pi["w"] + pi["b"], (h @ vf["w"] + vf["b"])[:, 0]
+
+    sample_actions_np = ActorCriticModule.sample_actions_np
+
+    # -- jax path (Learner) --
+
+    def forward(self, params, obs):
+        import jax
+        import jax.numpy as jnp
+
+        B = obs.shape[0]
+        x = obs.reshape(B, *self.frame_shape)
+        for layer in params["conv"]:
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + layer["b"]
+            x = jax.nn.relu(x)
+        h = x.reshape(B, -1)
+        t = params["trunk"][0]
+        h = jnp.tanh(h @ t["w"] + t["b"])
+        pi, vf = params["pi"][0], params["vf"][0]
+        return h @ pi["w"] + pi["b"], (h @ vf["w"] + vf["b"])[:, 0]

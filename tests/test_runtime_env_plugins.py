@@ -1,0 +1,107 @@
+"""Runtime-env plugin system: base-class extension point, env-var
+registration reaching worker processes, and the gated conda/container
+plugins (reference: _private/runtime_env/plugin.py:264 RuntimeEnvPlugin,
+conda.py, container plugin)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_conda_and_container_fail_fast_without_binaries():
+    """No conda/docker in this image: validation must raise an actionable
+    error at DECLARATION, not deep inside a worker."""
+    from ray_tpu._private.runtime_env import validate_runtime_env
+
+    with pytest.raises(ValueError, match="conda/mamba binary"):
+        validate_runtime_env({"conda": {"dependencies": ["numpy"]}})
+    with pytest.raises(ValueError, match="docker or podman"):
+        validate_runtime_env({"container": {"image": "img:latest"}})
+    # malformed values are caught before the binary gate
+    with pytest.raises(ValueError, match="image"):
+        validate_runtime_env({"container": {"tag": "x"}})
+    # unknown keys (no plugin) still rejected
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        validate_runtime_env({"not_a_plugin": 1})
+
+
+def test_custom_plugin_applies_in_workers(tmp_path):
+    """A third-party plugin registered via RAY_TPU_RUNTIME_ENV_PLUGINS:
+    create() runs once per distinct value (content-addressed), apply()
+    mutates the worker for the task, and the restore undoes it.
+    Subprocess: plugin env vars must be set before the cluster spawns."""
+    plug_dir = tmp_path / "plugmod"
+    plug_dir.mkdir()
+    (plug_dir / "markerplug.py").write_text(textwrap.dedent("""
+        import os
+        from ray_tpu._private.runtime_env_plugin import RuntimeEnvPlugin
+
+        class MarkerPlugin(RuntimeEnvPlugin):
+            name = "marker"
+
+            def validate(self, value):
+                if not isinstance(value, str):
+                    raise ValueError("marker must be a string")
+
+            def create(self, value, env_dir):
+                # count creations: content-addressing must make this run
+                # once per distinct value, not once per task
+                with open(os.path.join(env_dir, "creations"), "a") as f:
+                    f.write("c")
+
+            def apply(self, value, env_dir):
+                saved = os.environ.get("MARKER_PLUGIN")
+                os.environ["MARKER_PLUGIN"] = value
+                with open(os.path.join(env_dir, "creations")) as f:
+                    os.environ["MARKER_CREATES"] = str(len(f.read()))
+                def restore():
+                    if saved is None:
+                        os.environ.pop("MARKER_PLUGIN", None)
+                    else:
+                        os.environ["MARKER_PLUGIN"] = saved
+                return restore
+    """))
+    code = textwrap.dedent("""
+        import os
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def probe():
+            return (os.environ.get("MARKER_PLUGIN"),
+                    os.environ.get("MARKER_CREATES"))
+
+        env = {"runtime_env": {"marker": "hello"}}
+        v1, c1 = ray_tpu.get(probe.options(**env).remote(), timeout=120)
+        v2, c2 = ray_tpu.get(probe.options(**env).remote(), timeout=120)
+        assert v1 == v2 == "hello", (v1, v2)
+        assert c1 == c2 == "1", (c1, c2)  # created ONCE for both tasks
+        # a task without the plugin key must not see the env var (restore)
+        v3, _ = ray_tpu.get(probe.remote(), timeout=120)
+        assert v3 is None, v3
+        # validation runs driver-side through the plugin
+        try:
+            probe.options(runtime_env={"marker": 42}).remote()
+        except ValueError as e:
+            assert "marker must be a string" in str(e)
+        else:
+            raise AssertionError("plugin validate() not invoked")
+        print("PLUGIN_OK")
+        ray_tpu.shutdown()
+    """)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=f"{plug_dir}:{os.environ.get('PYTHONPATH', '')}",
+        RAY_TPU_RUNTIME_ENV_PLUGINS="markerplug:MarkerPlugin",
+        RAY_TPU_RUNTIME_ENV_DIR=str(tmp_path / "envs"),
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=240, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "PLUGIN_OK" in r.stdout
