@@ -59,7 +59,10 @@ class RuntimeEnvPlugin:
 
 
 _registry: dict[str, RuntimeEnvPlugin] = {}
-_registry_lock = threading.Lock()
+# RLock: env-var plugin modules call register_plugin() while the loader
+# still holds the lock (the load must be COMPLETE before the loaded flag
+# becomes visible, or a concurrent validate sees an empty registry)
+_registry_lock = threading.RLock()
 _env_var_loaded = False
 
 
@@ -76,16 +79,17 @@ def _load_env_var_plugins() -> None:
     in every process, so worker processes see the same plugin set as the
     driver that spawned them (env vars propagate through the raylet)."""
     global _env_var_loaded
+    import importlib
+
     with _registry_lock:
         if _env_var_loaded:
             return
+        for desc in filter(None,
+                           os.environ.get(_PLUGIN_ENV_VAR, "").split(",")):
+            mod_name, _, cls_name = desc.strip().partition(":")
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            register_plugin(cls())
         _env_var_loaded = True
-    import importlib
-
-    for desc in filter(None, os.environ.get(_PLUGIN_ENV_VAR, "").split(",")):
-        mod_name, _, cls_name = desc.strip().partition(":")
-        cls = getattr(importlib.import_module(mod_name), cls_name)
-        register_plugin(cls())
 
 
 def get_plugin(name: str) -> Optional[RuntimeEnvPlugin]:
@@ -158,6 +162,17 @@ def apply_plugin(name: str, value: Any) -> Optional[Callable[[], None]]:
                 if not os.path.isdir(lock_dir):
                     # creator vanished without ready/failed: take over
                     return apply_plugin(name, value)
+                try:
+                    # SIGKILLed creator (no finally ran): steal stale locks
+                    # like ensure_pip_env does, keyed on mtime age
+                    if time.time() - os.path.getmtime(lock_dir) > 600:
+                        try:
+                            os.rmdir(lock_dir)
+                        except OSError:
+                            pass
+                        return apply_plugin(name, value)
+                except OSError:
+                    pass  # lock vanished between the checks: loop re-checks
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"runtime_env plugin {name!r} not ready after 600s")

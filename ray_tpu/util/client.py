@@ -41,10 +41,15 @@ class _ClientSession:
     """One connected client = one dedicated server-side CoreWorker."""
 
     def __init__(self, node_handle):
+        import os as _os
+
         from ray_tpu._private.worker import CoreWorker
 
         gcs = node_handle.raylet.gcs
         job_id = JobID(gcs.call("next_job_id")["job_id"])
+        self.session_id = _os.urandom(8)
+        self.owner = None  # the conn currently speaking for this session
+        self.closed = False
         self.worker = CoreWorker(
             mode="driver",
             gcs_address=node_handle.gcs_address,
@@ -61,6 +66,15 @@ class _ClientSession:
             pass
 
 
+# a dropped connection keeps its session (and everything the session's
+# worker owns) alive this long for a reconnect-and-reclaim (reference:
+# proxier.py keeps SpecificServers alive briefly across reconnects)
+def _reconnect_grace_s() -> float:
+    import os as _os
+
+    return float(_os.environ.get("RAY_TPU_CLIENT_RECONNECT_GRACE_S", "30"))
+
+
 class ClientService:
     """RPC service: client_* methods proxied onto per-connection workers
     (reference: proxier.py routes each client to its SpecificServer)."""
@@ -68,21 +82,110 @@ class ClientService:
     def __init__(self, node_handle):
         self._node = node_handle
         self._lock = threading.Lock()
+        # every live session by id; session.owner is the conn currently
+        # speaking for it (None while parked in the grace window)
+        self._sessions: dict[bytes, _ClientSession] = {}
+        # session_id -> reap timer for parked sessions
+        self._reap_timers: dict[bytes, threading.Timer] = {}
 
     def _session(self, conn) -> _ClientSession:
         s = conn.meta.get("client_session")
         if s is None:
             s = _ClientSession(self._node)
-            conn.meta["client_session"] = s
-            conn.on_close.append(
-                lambda c: c.meta["client_session"].close())
+            with self._lock:
+                self._sessions[s.session_id] = s
+            self._attach(conn, s)
         return s
+
+    def _attach(self, conn, s: _ClientSession) -> None:
+        conn.meta["client_session"] = s
+        s.owner = conn
+        conn.on_close.append(lambda c: self._on_conn_close(c, s))
+
+    def _on_conn_close(self, conn, s: _ClientSession) -> None:
+        with self._lock:
+            if s.owner is not conn:
+                return  # session was stolen by a reconnect, or closed
+            s.owner = None
+        if getattr(s, "closed", False):
+            return
+        self._park(s)
+
+    def _park(self, s: _ClientSession) -> None:
+        """Connection lost: keep the session for the grace window instead
+        of tearing it down — an abrupt disconnect used to free every
+        object the client still referenced."""
+        grace = _reconnect_grace_s()
+        if grace <= 0:
+            self._close_session(s)
+            return
+        timer = threading.Timer(grace, self._reap, args=(s.session_id,))
+        timer.daemon = True
+        with self._lock:
+            self._reap_timers[s.session_id] = timer
+        timer.start()
+
+    def _reap(self, session_id: bytes) -> None:
+        with self._lock:
+            self._reap_timers.pop(session_id, None)
+            s = self._sessions.get(session_id)
+            if s is None or s.owner is not None:
+                return  # reclaimed in the meantime
+            del self._sessions[session_id]
+        s.close()
+
+    def _close_session(self, s: _ClientSession) -> None:
+        with self._lock:
+            s.closed = True
+            self._sessions.pop(s.session_id, None)
+            timer = self._reap_timers.pop(s.session_id, None)
+        if timer is not None:
+            timer.cancel()
+        s.close()
 
     # -- core API --
 
     def rpc_client_init(self, conn, msgid, p):
+        sid = p.get("session_id") if isinstance(p, dict) else None
+        if sid:
+            with self._lock:
+                session = self._sessions.get(sid)
+                if session is not None:
+                    timer = self._reap_timers.pop(sid, None)
+                    prev_owner = session.owner
+                    session.owner = conn
+            if session is not None:
+                if timer is not None:
+                    timer.cancel()
+                # steal from a zombie conn the server hasn't seen die yet
+                # (client-side drop, NAT timeout) — its eventual close is
+                # a no-op because it no longer owns the session. A re-init
+                # on the session's CURRENT conn is an idempotent reclaim.
+                if prev_owner is not None and prev_owner is not conn:
+                    prev_owner.meta.pop("client_session", None)
+                conn.meta["client_session"] = session
+                conn.on_close.append(
+                    lambda c: self._on_conn_close(c, session))
+                return {"job_id": session.worker.job_id.binary(),
+                        "session_id": session.session_id,
+                        "reclaimed": True}
+            # grace expired / unknown: do NOT silently mint a session —
+            # the client must see session-loss explicitly and re-init
+            return {"session_id": b"", "reclaimed": False,
+                    "session_lost": True}
         s = self._session(conn)
-        return {"job_id": s.worker.job_id.binary()}
+        return {"job_id": s.worker.job_id.binary(),
+                "session_id": s.session_id,
+                "reclaimed": False}
+
+    def rpc_client_disconnect(self, conn, msgid, p):
+        """Graceful goodbye: close the session NOW instead of parking it
+        for the grace window (repeated short-lived clients must not
+        accumulate 30s-lived CoreWorkers server-side)."""
+        s = conn.meta.get("client_session")
+        if s is not None:
+            self._close_session(s)
+        return {"ok": True}
 
     def rpc_client_put(self, conn, msgid, p):
         s = self._session(conn)
@@ -182,8 +285,84 @@ class ClientServer:
 # ---------------------------------------------------------------------------
 
 
+class _ReconnectingRpc:
+    """Client-side connection with session reclaim: a dropped TCP
+    connection heals in place (RpcClient.reconnect) and re-presents the
+    session token, so the server re-attaches the SAME proxied CoreWorker
+    — every outstanding ObjectRef stays valid. If the reconnect grace
+    expired server-side, calls fail with an explicit session-lost error
+    instead of silently running against a fresh empty session. Retried
+    calls are at-least-once; duplicate task submission is safe because
+    task/object ids are client-minted and the store keeps first-writer."""
+
+    def __init__(self, address: str):
+        self._rpc = RpcClient(address)
+        self._heal_lock = threading.Lock()
+        self.session_id: bytes | None = None
+        self._session_lost = False
+
+    def init_session(self) -> dict:
+        r = self._rpc.call("client_init", {"session_id": self.session_id})
+        self.session_id = r["session_id"]
+        return r
+
+    def _heal(self) -> None:
+        import time
+
+        with self._heal_lock:
+            if self._session_lost:
+                raise ConnectionError(self._LOST_MSG)
+            # a failed send marks the connection dead slightly AFTER the
+            # failure surfaces (the reader thread notices the close); spin
+            # briefly so reconnect() actually replaces the socket instead
+            # of reporting the dying connection as healthy
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    if self._rpc.reconnect():
+                        r = self._rpc.call(
+                            "client_init", {"session_id": self.session_id})
+                        break
+                except ConnectionError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise ConnectionError("client server unreachable")
+                time.sleep(0.1)
+            if not r.get("reclaimed"):
+                # STICKY: every later call must keep failing loudly — a
+                # silent fresh session would strand the app's old refs
+                self._session_lost = True
+                raise ConnectionError(self._LOST_MSG)
+            self.session_id = r["session_id"]
+
+    _LOST_MSG = ("client session lost (reconnect grace expired on the "
+                 "server); call ray_tpu.shutdown() + init() for a fresh "
+                 "session — previous ObjectRefs are gone")
+
+    def call(self, method: str, payload: Any = None, timeout=None):
+        if self._session_lost:
+            raise ConnectionError(self._LOST_MSG)
+        try:
+            return self._rpc.call(method, payload, timeout=timeout)
+        except ConnectionError:
+            self._heal()
+            return self._rpc.call(method, payload, timeout=timeout)
+
+    def call_async(self, method: str, payload: Any = None):
+        return self._rpc.call_async(method, payload)
+
+    def close(self) -> None:
+        try:
+            # graceful goodbye: the server closes the session eagerly
+            # instead of parking it for the reconnect grace window
+            self._rpc.call("client_disconnect", {}, timeout=5)
+        except Exception:  # noqa: BLE001 — already-dead connection is fine
+            pass
+        self._rpc.close()
+
+
 class _GcsProxy:
-    def __init__(self, rpc: RpcClient):
+    def __init__(self, rpc):
         self._rpc = rpc
 
     def call(self, method: str, payload: Any = None, timeout=None):
@@ -201,7 +380,7 @@ class _GcsProxy:
 
 
 class _PeerProxy:
-    def __init__(self, rpc: RpcClient, address: str):
+    def __init__(self, rpc, address: str):
         self._rpc = rpc
         self.address = address
 
@@ -220,8 +399,8 @@ class ClientWorker:
     mode = "client"
 
     def __init__(self, address: str):
-        self._rpc = RpcClient(address, auto_reconnect=False)
-        self.job_id = JobID(self._rpc.call("client_init")["job_id"])
+        self._rpc = _ReconnectingRpc(address)
+        self.job_id = JobID(self._rpc.init_session()["job_id"])
         self.gcs = _GcsProxy(self._rpc)
         # server-side raylet address, for kill()'s peer routing
         self.raylet = _PeerProxy(self._rpc, "")
