@@ -50,6 +50,10 @@ class GcsService:
         self._node_seq = 0
         self._node_tombstones: list[tuple[int, bytes]] = []
         self._tombstone_floor = 0  # removals below this seq were trimmed
+        # pushed node_delta ordering: seq-ordered outbox (appended under
+        # _lock) + a single-flusher lock so publishes can't reorder
+        self._delta_outbox: list[dict] = []
+        self._delta_pub_lock = threading.Lock()
         # actor_id(bytes) -> {state, class_name, node_id, raylet_address,
         #                     num_restarts, max_restarts, spec}
         self.actors: dict[bytes, dict] = {}
@@ -168,6 +172,23 @@ class GcsService:
                 with self._lock:
                     self._subs[topic].discard(conn)
 
+    def _queue_node_delta_locked(self, payload: dict) -> None:
+        """Called under self._lock at the seq-assignment site: appending
+        while holding the lock keeps the outbox in seq order, so the
+        flusher (outside the lock) can never publish deltas out of order —
+        a reordered push would hit subscribers' seq gap guard and stall
+        the push channel until their next pull."""
+        self._delta_outbox.append(payload)
+
+    def _flush_node_deltas(self) -> None:
+        while True:
+            with self._delta_pub_lock:
+                with self._lock:
+                    if not self._delta_outbox:
+                        return
+                    payload = self._delta_outbox.pop(0)
+                self._publish("node_delta", payload)
+
     def _health_loop(self) -> None:
         cfg = global_config()
         interval = cfg.gcs_heartbeat_interval_ms / 1000.0
@@ -207,6 +228,9 @@ class GcsService:
         self._publish("node_death", {"node_id": node_id})
         with self._lock:
             self._node_seq += 1
+            tomb_seq = self._node_seq
+            self._queue_node_delta_locked(
+                {"delta": [], "removed": [node_id], "seq": tomb_seq})
             self._node_tombstones.append((self._node_seq, node_id))
             if len(self._node_tombstones) > 1000:
                 # clients older than the trimmed horizon get a full resync
@@ -219,6 +243,9 @@ class GcsService:
                 self.actors[aid]["state"] = "DEAD"
         for aid in affected:
             self._publish("actor:" + aid.hex(), {"state": "DEAD", "reason": "node died"})
+        # push-path of the delta syncer: subscribers learn of the removal
+        # NOW; the 1 Hz heartbeat pull remains the reconciliation backstop
+        self._flush_node_deltas()
 
     # ---------------- RPC: KV ----------------
 
@@ -282,7 +309,12 @@ class GcsService:
                 "last_heartbeat": time.monotonic(),
             }
             self._bump_node_seq_locked(info)
+            self._queue_node_delta_locked({
+                "delta": [self._node_view_locked(p["node_id"], info)],
+                "removed": [], "seq": info["_seq"],
+            })
         self._publish("node_added", {"node_id": p["node_id"], "address": p["address"]})
+        self._flush_node_deltas()
         return {"ok": True}
 
     def rpc_heartbeat(self, conn, msgid, p):
@@ -296,17 +328,28 @@ class GcsService:
             if info is None:
                 return {"ok": False, "reregister": True}
             info["last_heartbeat"] = time.monotonic()
+            # a REVIVAL (health-loop death then the node resumed
+            # heartbeating) must re-version the entry even when no value
+            # changed: peers popped it on the tombstone and only a newer
+            # _seq ever re-adds it to their deltas
+            changed = not info["alive"]
             info["alive"] = True
-            # bump the sync version ONLY when a reported value actually
-            # changed — otherwise every heartbeat would invalidate every
-            # peer's delta and each tick would degenerate to a full table
-            changed = False
+            # ...otherwise bump the sync version ONLY when a reported value
+            # actually changed — every-tick bumps would degenerate each
+            # delta to a full table
             for k in ("available", "load", "pending_shapes", "disk_used_frac"):
                 if k in p and info.get(k) != p[k]:
                     info[k] = p[k]
                     changed = True
             if changed:
                 self._bump_node_seq_locked(info)
+                # push-path: peers see the new view without waiting for
+                # their own next pull tick (reference: RaySyncer's pushed
+                # version-stamped deltas, ray_syncer.h:86)
+                self._queue_node_delta_locked({
+                    "delta": [self._node_view_locked(p["node_id"], info)],
+                    "removed": [], "seq": info["_seq"],
+                })
             reply = {"ok": True}
             if "seen_seq" in p:
                 seen = p["seen_seq"]
@@ -323,6 +366,7 @@ class GcsService:
                 reply["removed"] = [
                     nid for seq, nid in self._node_tombstones if seq > seen
                 ]
+        self._flush_node_deltas()
         return reply
 
     def rpc_drain_node(self, conn, msgid, p):

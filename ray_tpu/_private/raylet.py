@@ -174,6 +174,23 @@ class Raylet:
                 "store_socket": store_socket,
             },
         )
+        # push-path of the delta syncer: node-table changes arrive the
+        # moment the GCS applies them; the 1 Hz heartbeat pull stays as
+        # the gap-filling reconciliation (reference: ray_syncer.h:86 —
+        # bidirectional pushed deltas, not poll-only)
+        self._delta_sub: RpcClient | None = None
+        self._subscribe_node_deltas()
+        # immediate baseline pull: pushes are gap-guarded against the local
+        # version, so without this the push channel stays inert until the
+        # first 1 Hz heartbeat tick establishes a base
+        try:
+            reply = self.gcs.call("heartbeat", {
+                "node_id": node_id.binary(), "seen_seq": 0,
+            })
+            if reply.get("ok"):
+                self._apply_cluster_delta(reply)
+        except Exception:  # noqa: BLE001 — the pull loop reconciles anyway
+            pass
         self._threads = [
             threading.Thread(target=self._heartbeat_loop, daemon=True, name="raylet-hb"),
             threading.Thread(target=self._dep_loop, daemon=True, name="raylet-deps"),
@@ -198,6 +215,11 @@ class Raylet:
                 w.proc.terminate()
         self.server.stop()
         self._store_events.close()
+        if self._delta_sub is not None:
+            try:
+                self._delta_sub.close()
+            except Exception:  # noqa: BLE001
+                pass
         self.gcs.close()
         self.store.close()
 
@@ -206,6 +228,10 @@ class Raylet:
         interval = cfg.gcs_heartbeat_interval_ms / 1000.0
         while not self._stopped.wait(interval):
             try:
+                if self._delta_sub is None:
+                    # push channel lost (GCS flap, failed subscribe):
+                    # retry — pull-only is correct but slower
+                    self._subscribe_node_deltas()
                 with self._lock:
                     avail = dict(self.available)
                     load = len(self._queued)
@@ -262,8 +288,43 @@ class Raylet:
                     pass
                 try:
                     self.gcs = RpcClient(self.gcs_address)
+                    # the push subscription died with the old GCS conn
+                    self._subscribe_node_deltas()
                 except Exception:  # noqa: BLE001
                     pass
+
+    def _subscribe_node_deltas(self) -> None:
+        if self._delta_sub is not None:
+            try:
+                self._delta_sub.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._delta_sub = None
+        client = None
+        try:
+            client = RpcClient(
+                self.gcs_address, notify_handler=self._on_node_delta_push)
+            client.call("subscribe", {"topic": "node_delta"})
+            self._delta_sub = client
+        except Exception:  # noqa: BLE001 — pull sync still covers us; the
+            # heartbeat loop retries the subscription next tick
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _on_node_delta_push(self, topic: str, payload: dict) -> None:
+        """Pushed node-table change. Applied only when it is the NEXT
+        version — a push stream with gaps (late subscribe, dropped conn)
+        must not leapfrog intermediate changes; the heartbeat pull
+        reconciles those by asking with seen_seq."""
+        if topic != "node_delta":
+            return
+        with self._lock:  # RLock: atomic check-then-apply vs the pull path
+            if payload.get("seq") != self._cluster_seq + 1:
+                return
+            self._apply_cluster_delta(payload)
 
     def _apply_cluster_delta(self, reply: dict) -> None:
         """Merge one heartbeat reply's node-table changes into the local

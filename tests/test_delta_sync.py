@@ -132,3 +132,61 @@ def test_revived_node_survives_same_window_tombstone():
     Raylet._apply_cluster_delta(view, reply)
     assert b in view._cluster_view, "revived node erased by stale tombstone"
     assert view._cluster_seq == reply["seq"]
+
+
+def test_push_deltas_beat_the_pull_tick(ray_cluster):
+    """A node-table change reaches peers via the pushed node_delta channel
+    well inside the 1 Hz pull period — the syncer is push+pull now, with
+    the pull as the gap-filling backstop (reference: ray_syncer.h pushed
+    version-stamped deltas)."""
+    cluster = ray_cluster
+    head = cluster.head.raylet
+    assert head._delta_sub is not None, "raylet did not subscribe to pushes"
+    t0 = time.monotonic()
+    worker_raylet = cluster.add_node(num_cpus=1)
+    wid = worker_raylet.node_id.binary()
+    # visible via push within a fraction of the 1s heartbeat period: the
+    # registration publish reaches the subscriber's reader thread directly
+    deadline = time.monotonic() + 0.5
+    seen_at = None
+    while time.monotonic() < deadline:
+        with head._lock:
+            if wid in head._cluster_view:
+                seen_at = time.monotonic() - t0
+                break
+        time.sleep(0.01)
+    assert seen_at is not None, (
+        "new node not visible within 0.5s — push path not working "
+        "(pull alone would take up to a full heartbeat period)")
+    cluster.remove_node(worker_raylet)
+
+
+def test_push_with_gap_is_ignored_until_pull_reconciles():
+    """A pushed delta whose seq leapfrogs the local version must be
+    DROPPED (applying it would skip intermediate changes); the pull path
+    owns reconciliation."""
+    import threading
+
+    from ray_tpu._private.raylet import Raylet
+
+    class _View:
+        _lock = threading.RLock()
+        _cluster_view = {}
+        _cluster_seq = 5
+        _apply_cluster_delta = Raylet._apply_cluster_delta
+
+    v = _View()
+    # next-in-sequence push applies...
+    Raylet._on_node_delta_push(
+        v, "node_delta",
+        {"delta": [{"node_id": b"n1", "x": 1}], "removed": [], "seq": 6})
+    assert b"n1" in v._cluster_view and v._cluster_seq == 6
+    # ...a gapped push does not
+    Raylet._on_node_delta_push(
+        v, "node_delta",
+        {"delta": [{"node_id": b"n2", "x": 1}], "removed": [], "seq": 9})
+    assert b"n2" not in v._cluster_view and v._cluster_seq == 6
+    # ...and a stale push does not regress the version
+    Raylet._on_node_delta_push(
+        v, "node_delta", {"delta": [], "removed": [b"n1"], "seq": 4})
+    assert b"n1" in v._cluster_view and v._cluster_seq == 6

@@ -95,7 +95,16 @@ def test_delta_sync_payload_is_o_changes(big_cluster):
     })
     assert len(first.get("delta", ())) >= N_NODES  # cold sync sees everyone
     seq = first["seq"]
-    time.sleep(2.5)  # >2 heartbeat periods of steady state
+    time.sleep(3.5)  # several heartbeat periods of steady state
+    # re-baseline once: late boot-time churn (a node's first load report)
+    # may land during the first window; the claim is about STEADY state
+    reply = gcs.call("heartbeat", {
+        "node_id": cluster.head.node_id.binary(),
+        "available": {}, "load": 0, "pending_shapes": [],
+        "seen_seq": seq,
+    })
+    seq = reply["seq"]
+    time.sleep(2.5)
     reply = gcs.call("heartbeat", {
         "node_id": cluster.head.node_id.binary(),
         "available": {}, "load": 0, "pending_shapes": [],
