@@ -52,6 +52,7 @@ def run_gpt_bench(
         # benching a shorter context: positional table slices down free
         pass
     n_params = gpt_num_params(cfg)
+    model_label = _model_label(config, n_params)
     params = gpt_init(jax.random.PRNGKey(0), cfg)
 
     tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
@@ -75,7 +76,7 @@ def run_gpt_bench(
         achieved = tps * 6.0 * n_params / 1e12
         mfu = achieved / peak_tflops if peak_tflops else 0.0
         return {
-            "metric": f"gpt2_125m_train_tokens_per_sec_per_chip_{platform}{tag}",
+            "metric": f"{model_label}_train_tokens_per_sec_per_chip_{platform}{tag}",
             "value": round(tps, 1),
             "unit": "tokens/sec",
             # no reference GPT/MFU number exists (BASELINE.md) — the bar is
@@ -108,6 +109,17 @@ def run_gpt_bench(
             publish(make_result(tokens_per_step * done / dt))
     dt = time.perf_counter() - t0
     return make_result(tokens_per_step * steps / dt)
+
+
+def _model_label(config: str, n_params: int) -> str:
+    """Metric label derived from the ACTUAL benched config, never hardcoded:
+    a tiny-config fallback run must not be labeled as the 125M headline."""
+    canonical = {"gpt2_small": "gpt2_125m", "gpt2_medium": "gpt2_350m"}
+    if config in canonical:
+        return canonical[config]
+    if n_params >= 1e6:
+        return f"gpt2_{config}_{n_params / 1e6:.0f}m"
+    return f"gpt2_{config}_{n_params / 1e3:.0f}k"
 
 
 # Known per-chip peak bf16 TFLOP/s by device_kind substring (shared with
