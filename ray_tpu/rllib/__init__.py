@@ -20,6 +20,8 @@ from ray_tpu.rllib.algorithms import (
     ImpalaConfig,
     PPO,
     PPOConfig,
+    R2D2,
+    R2D2Config,
 )
 from ray_tpu.rllib.connectors import (
     ClipObs,
@@ -49,7 +51,11 @@ from ray_tpu.rllib.env import (
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import Learner
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
-from ray_tpu.rllib.rl_module import ActorCriticModule, QModule
+from ray_tpu.rllib.rl_module import (
+    ActorCriticModule,
+    QModule,
+    RecurrentQModule,
+)
 
 __all__ = [
     "APPO",
@@ -86,6 +92,9 @@ __all__ = [
     "PPO",
     "PPOConfig",
     "QModule",
+    "R2D2",
+    "R2D2Config",
+    "RecurrentQModule",
     "ReplayBuffer",
     "VectorEnv",
     "make_env",

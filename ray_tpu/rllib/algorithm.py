@@ -35,6 +35,7 @@ class AlgorithmConfig:
         self.seed = 0
         self.mesh = None  # optional jax Mesh with a 'data' axis for the learner
         self.output = None  # JSONL experience-output path (offline_data)
+        self.external = None  # (host, port, obs_dim, num_actions) policy server
         self.extra: dict = {}
 
     # -- builder surface (mirrors the reference's groups) --
@@ -70,6 +71,16 @@ class AlgorithmConfig:
 
     def learners(self, mesh=None) -> "AlgorithmConfig":
         self.mesh = mesh
+        return self
+
+    def external_env(self, port: int, obs_dim: int, num_actions: int,
+                     host: str = "127.0.0.1") -> "AlgorithmConfig":
+        """Experience arrives from external PolicyClient processes instead
+        of an in-process env: the algorithm starts a PolicyServerInput on
+        `port` (0 = ephemeral; read it back from `algo.policy_server.port`).
+        The env's spaces cannot be introspected remotely, so declare them
+        (reference: policy_server_input.py requires the same)."""
+        self.external = (host, int(port), int(obs_dim), int(num_actions))
         return self
 
     def offline_data(self, output: str | None = None) -> "AlgorithmConfig":
@@ -116,7 +127,18 @@ class Algorithm:
     def _setup(self) -> None:
         cfg = self.config
         factory = self._runner_factory()
-        if cfg.num_env_runners > 0:
+        if cfg.external is not None:
+            from ray_tpu.rllib.external import PolicyServerInput
+
+            host, port, obs_dim, num_actions = cfg.external
+            self.policy_server = PolicyServerInput(
+                port, obs_dim, num_actions, factory,
+                rollout_length=cfg.rollout_length, mode=self.runner_mode,
+                host=host, seed=cfg.seed,
+            )
+            self._local_runner = self.policy_server
+            info = self.policy_server.env_info()
+        elif cfg.num_env_runners > 0:
             import ray_tpu
             from ray_tpu.rllib.env_runner import EnvRunner
 
@@ -230,6 +252,8 @@ class Algorithm:
     def stop(self) -> None:
         import ray_tpu
 
+        if getattr(self, "policy_server", None) is not None:
+            self.policy_server.close()
         for r in self._runners:
             try:
                 ray_tpu.kill(r)

@@ -34,3 +34,7 @@ from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
 
 __all__ += ["Bandit", "BanditConfig", "BanditLinTSConfig",
             "BanditLinUCBConfig", "QMIX", "QMIXConfig"]
+
+from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
+
+__all__ += ["R2D2", "R2D2Config"]

@@ -1,0 +1,147 @@
+"""R2D2 — recurrent replay distributed DQN.
+
+Equivalent of the reference's R2D2 (reference: rllib_contrib/r2d2/src/
+rllib_r2d2/r2d2.py — DQN over an LSTM wrapper with `replay_sequence_length`
+windows, stored recurrent states, and burn-in; Kapturowski et al. 2019).
+TPU-first shape: the learner consumes fixed-length [B, T] sequence
+minibatches through ONE jitted update whose recurrence is a `lax.scan`
+(static shapes, compiler-unrolled burn-in prefix); rollout workers thread
+GRU state in numpy and store it per-sequence ('stored state', not
+zero-init, so replayed hidden states match collection).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.replay_buffer import SequenceReplayBuffer
+from ray_tpu.rllib.rl_module import RecurrentQModule
+
+
+def r2d2_loss(module, params, batch, config):
+    """Sequence double-Q TD loss with burn-in (pure jax).
+
+    Burn-in: the first `burn_in` steps of each sequence warm the hidden
+    state from the stored `state_in` under stop_gradient (both nets), and
+    contribute no loss. Truncation boundaries (done without terminated)
+    are masked out — their successor state is a different episode whose
+    value must not bootstrap through. The final step of every sequence has
+    no in-sequence successor and is likewise excluded.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    burn = int(config["burn_in"])
+    gamma = config["gamma"]
+    tgt = batch["target_params"]
+    obs, resets = batch["obs"], batch["resets"]
+    h0_online = h0_target = batch["state_in"]
+    if burn > 0:
+        _, h0_online = module.forward_seq(
+            params, obs[:, :burn], batch["state_in"], resets[:, :burn])
+        _, h0_target = module.forward_seq(
+            tgt, obs[:, :burn], batch["state_in"], resets[:, :burn])
+        h0_online = jax.lax.stop_gradient(h0_online)
+    obs_t, resets_t = obs[:, burn:], resets[:, burn:]
+    q_online, _ = module.forward_seq(params, obs_t, h0_online, resets_t)
+    q_target, _ = module.forward_seq(tgt, obs_t, h0_target, resets_t)
+
+    actions = batch["actions"][:, burn:]
+    rewards = batch["rewards"][:, burn:]
+    dones = batch["dones"][:, burn:]
+    terms = batch["terminateds"][:, burn:]
+
+    q_taken = jnp.take_along_axis(q_online, actions[..., None], axis=-1)[..., 0]
+    best_next = jnp.argmax(q_online[:, 1:], axis=-1)
+    q_next = jnp.take_along_axis(
+        q_target[:, 1:], best_next[..., None], axis=-1)[..., 0]
+    not_term = 1.0 - terms[:, :-1].astype(q_next.dtype)
+    target = rewards[:, :-1] + gamma * not_term * q_next
+    td = q_taken[:, :-1] - jax.lax.stop_gradient(target)
+    # truncated boundary: no valid in-sequence successor value
+    valid = 1.0 - (dones[:, :-1] & ~terms[:, :-1]).astype(td.dtype)
+    denom = jnp.maximum(jnp.sum(valid), 1.0)
+    loss = jnp.sum(valid * jnp.square(td)) / denom
+    return loss, {
+        "q_mean": jnp.sum(valid * q_taken[:, :-1]) / denom,
+        "td_abs": jnp.sum(valid * jnp.abs(td)) / denom,
+    }
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.rollout_length = 16      # stored sequence length
+        self.burn_in = 4              # warm-up prefix inside each sequence
+        self.rnn_hidden = 64
+        self.buffer_capacity = 4_000  # in sequences
+        self.learning_starts = 64     # in sequences
+        self.target_update_freq = 200
+        self.updates_per_iteration = 32
+        self.seq_minibatch = 32       # sequences per gradient step
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 8_000
+        self.lr = 1e-3
+        self.algo_class = R2D2
+
+
+class R2D2(Algorithm):
+    runner_mode = "epsilon_greedy"
+
+    def _runner_factory(self):
+        hidden = tuple(self.config.hidden)
+        rnn_hidden = self.config.rnn_hidden
+        return lambda obs_dim, n_act: RecurrentQModule(
+            obs_dim, n_act, hidden, rnn_hidden=rnn_hidden)
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        if not 0 <= cfg.burn_in < cfg.rollout_length:
+            raise ValueError(
+                f"burn_in ({cfg.burn_in}) must be < rollout_length "
+                f"({cfg.rollout_length})")
+        module = RecurrentQModule(self.obs_dim, self.num_actions,
+                                  cfg.hidden, rnn_hidden=cfg.rnn_hidden)
+        self.learner = Learner(
+            module,
+            r2d2_loss,
+            config={"gamma": cfg.gamma, "burn_in": cfg.burn_in},
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self.buffer = SequenceReplayBuffer(
+            cfg.buffer_capacity, cfg.rollout_length, self.obs_dim,
+            state_dim=cfg.rnn_hidden, seed=cfg.seed)
+        self._target_params = self.learner.get_weights_np()
+        self._grad_steps = 0
+        self._broadcast_weights(self.learner.get_weights_np(), self._epsilon())
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._total_env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        for b in self._sample_all():
+            self.buffer.add_rollout(b)
+        metrics_acc: dict[str, list[float]] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                mb = self.buffer.sample(cfg.seq_minibatch)
+                mb["target_params"] = self._target_params
+                m = self.learner.update(mb)
+                self._grad_steps += 1
+                if self._grad_steps % cfg.target_update_freq == 0:
+                    self._target_params = self.learner.get_weights_np()
+                for k, v in m.items():
+                    metrics_acc.setdefault(k, []).append(v)
+        self._broadcast_weights(self.learner.get_weights_np(), self._epsilon())
+        out = {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+        out["epsilon"] = self._epsilon()
+        out["replay_sequences"] = len(self.buffer)
+        return out

@@ -364,7 +364,59 @@ class ContextualBanditEnv(Env):
         return self._x, reward, True, False
 
 
+class TwoStepGame:
+    """The QMIX paper's two-step cooperative game (Rashid et al. 2018;
+    reference: rllib/examples/env/two_step_game.py — THE canonical QMIX
+    eval env). Step 1: agent a0's action selects which matrix game is
+    played. Step 2: state 2A pays 7 for any joint action; state 2B pays
+    [[0, 1], [1, 8]]. The optimum (choose 2B, then both play 1 -> 8)
+    requires agent a1 to condition on the state a0 produced — value
+    factorization with a state-conditioned mixer finds it, independent
+    learners typically settle on the safe 7.
+
+    Same TEAM-reward protocol as CooperativeMatrixGame: obs/action dicts,
+    one scalar reward, `global_state()` for the mixer.
+    """
+
+    num_actions = 2
+    observation_dim = 3  # one-hot of {s0, s2A, s2B}
+    agent_ids = ["a0", "a1"]
+    max_episode_steps = 2
+
+    def __init__(self):
+        self._state = 0  # 0 -> start, 1 -> 2A, 2 -> 2B
+
+    def _obs(self) -> dict:
+        o = np.zeros(3, np.float32)
+        o[self._state] = 1.0
+        return {a: o.copy() for a in self.agent_ids}
+
+    def reset(self, seed: int | None = None) -> dict:
+        self._state = 0
+        return self._obs()
+
+    def global_state(self) -> np.ndarray:
+        s = np.zeros(3, np.float32)
+        s[self._state] = 1.0
+        return s
+
+    def step(self, actions: dict):
+        if self._state == 0:
+            self._state = 1 if actions["a0"] == 0 else 2
+            return self._obs(), 0.0, False, False
+        if self._state == 1:
+            reward = 7.0
+        else:
+            payoff = ((0.0, 1.0), (1.0, 8.0))
+            reward = payoff[actions["a0"]][actions["a1"]]
+        return self._obs(), reward, True, False
+
+    def close(self) -> None:
+        pass
+
+
 _REGISTRY["CooperativeMatrixGame"] = CooperativeMatrixGame
+_REGISTRY["TwoStepGame"] = TwoStepGame
 _REGISTRY["ContextualBandit"] = ContextualBanditEnv
 
 
@@ -441,3 +493,68 @@ class MiniBreakout(Env):
 
 
 _REGISTRY["MiniBreakout"] = MiniBreakout
+
+
+class TMaze(Env):
+    """Memory corridor (Bakker 2002's T-maze, the standard recurrence
+    probe; reference: R2D2/rllib_contrib recurrent learning tests use
+    memory-requiring envs like StatelessCartPole). The goal side is shown
+    ONLY in the first observation; the agent walks a featureless corridor
+    and must turn the remembered way at the junction. A feed-forward
+    policy is capped at coin-flip performance at the junction; a recurrent
+    one solves it.
+
+    obs = [cue (+1 up / -1 down, zero after t=0), at_junction, pos/L].
+    actions: 0 = forward, 1 = up, 2 = down (turns are no-ops with a small
+    penalty before the junction). Reward: +4.0 correct turn, -0.1 wrong,
+    -0.01 per step.
+    """
+
+    num_actions = 3
+    observation_dim = 3
+
+    def __init__(self, length: int = 4, seed: int = 0):
+        self.length = length
+        self.max_episode_steps = 3 * length + 4
+        self._rng = np.random.default_rng(seed)
+        self._pos = 0
+        self._steps = 0
+        self._goal_up = True
+
+    def _obs(self, show_cue: bool) -> np.ndarray:
+        return np.array(
+            [
+                (1.0 if self._goal_up else -1.0) if show_cue else 0.0,
+                1.0 if self._pos >= self.length else 0.0,
+                self._pos / self.length,
+            ],
+            np.float32,
+        )
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._pos = 0
+        self._steps = 0
+        self._goal_up = bool(self._rng.random() < 0.5)
+        return self._obs(show_cue=True)
+
+    def step(self, action: int):
+        self._steps += 1
+        reward = -0.01
+        terminated = False
+        at_junction = self._pos >= self.length
+        if action == 0 and not at_junction:
+            self._pos += 1
+        elif action in (1, 2):
+            if at_junction:
+                correct = (action == 1) == self._goal_up
+                reward += 4.0 if correct else -0.1
+                terminated = True
+            else:
+                reward -= 0.04  # turning against a corridor wall
+        truncated = self._steps >= self.max_episode_steps
+        return self._obs(show_cue=False), reward, terminated, truncated
+
+
+_REGISTRY["TMaze"] = TMaze

@@ -45,6 +45,15 @@ class EnvRunner:
         self._rng = np.random.default_rng(seed + 1000)
         self._params: dict | None = None
         self.epsilon = 1.0
+        # recurrent modules: the runner owns per-env hidden-state rows,
+        # persisted ACROSS rollouts (sequences continue mid-episode; the
+        # stored state_in makes replayed sequences self-contained — R2D2's
+        # stored-state strategy, Kapturowski et al. 2019)
+        self._recurrent = bool(getattr(self.module, "is_recurrent", False))
+        self._h = (
+            self.module.initial_state(self.vec.num_envs)
+            if self._recurrent else None
+        )
 
     def set_weights(self, params: dict, epsilon: float | None = None) -> None:
         self._params = params
@@ -93,6 +102,11 @@ class EnvRunner:
             batch["bootstrap_values"] = np.zeros((T, E), np.float32)
         else:
             batch["next_obs"] = np.empty((T, E, obs_dim), np.float32)
+        if self._recurrent:
+            # hidden state at rollout start + whether step t begins a new
+            # episode (t=0 rows are continuations unless state_in is zero)
+            batch["state_in"] = self._h.copy()
+            batch["resets"] = np.zeros((T, E), np.bool_)
         for t in range(T):
             obs = self.pipeline(self.vec.obs)
             batch["obs"][t] = obs
@@ -122,7 +136,10 @@ class EnvRunner:
                         -self.vec.action_bound, self.vec.action_bound,
                     ).astype(np.float32)
             else:
-                q = self.module.forward_np(self._params, obs)
+                if self._recurrent:
+                    q, self._h = self.module.step_np(self._params, obs, self._h)
+                else:
+                    q = self.module.forward_np(self._params, obs)
                 greedy = np.argmax(q, axis=-1)
                 random_a = self._rng.integers(0, self.vec.num_actions, size=E)
                 explore = self._rng.uniform(size=E) < self.epsilon
@@ -142,6 +159,12 @@ class EnvRunner:
                     batch["bootstrap_values"][t] = np.where(dones, v_true, 0.0)
             else:
                 batch["next_obs"][t] = self.pipeline.peek(true_next_obs)
+            if self._recurrent:
+                if t + 1 < T:
+                    batch["resets"][t + 1] = dones
+                if dones.any():
+                    # fresh episode -> fresh hidden state
+                    self._h = np.where(dones[:, None], 0.0, self._h)
             self.pipeline.on_dones(dones)
         if self.mode == "actor_critic":
             # bootstrap values for the obs after the last step

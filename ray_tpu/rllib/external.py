@@ -1,0 +1,337 @@
+"""External-env serving: PolicyClient / PolicyServerInput.
+
+Equivalent of the reference's external-application pattern
+(reference: rllib/env/policy_client.py:1, rllib/env/policy_server_input.py:1
+— an external simulator process drives episodes over the network; the
+algorithm trains on the streamed experience). The reference speaks HTTP +
+pickled payloads; here the wire is newline-delimited JSON over TCP so a
+client needs nothing but a socket — any language, no framework install.
+
+Server-side ("remote") inference only: the server runs the current policy
+for every `get_action`, so clients never hold weights and exploration
+state (epsilon) stays consistent with the trainer.
+
+`PolicyServerInput` duck-types the EnvRunner surface (`env_info`,
+`set_weights`, `sample`, `get_state`, `set_state`), so the Algorithm
+driver loop is unchanged — configure with
+`config.external_env(port, obs_dim, num_actions)` and episodes arrive
+from outside instead of from an in-process VectorEnv.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import uuid
+
+import numpy as np
+
+
+class PolicyClient:
+    """Client for an external env loop (reference: policy_client.py API —
+    start_episode / get_action / log_returns / end_episode)."""
+
+    def __init__(self, address: str, timeout_s: float = 60.0):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout_s)
+        self._file = self._sock.makefile("rwb")
+
+    def _call(self, payload: dict) -> dict:
+        self._file.write(json.dumps(payload).encode() + b"\n")
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("policy server closed the connection")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise RuntimeError(f"policy server error: {resp['error']}")
+        return resp
+
+    def start_episode(self) -> str:
+        return self._call({"cmd": "start_episode"})["episode_id"]
+
+    def get_action(self, episode_id: str, obs) -> int:
+        resp = self._call({
+            "cmd": "get_action", "episode_id": episode_id,
+            "obs": np.asarray(obs, np.float32).reshape(-1).tolist(),
+        })
+        return resp["action"]
+
+    def log_returns(self, episode_id: str, reward: float) -> None:
+        self._call({"cmd": "log_returns", "episode_id": episode_id,
+                    "reward": float(reward)})
+
+    def end_episode(self, episode_id: str, obs) -> None:
+        self._call({
+            "cmd": "end_episode", "episode_id": episode_id,
+            "obs": np.asarray(obs, np.float32).reshape(-1).tolist(),
+        })
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Episode:
+    __slots__ = ("pending_obs", "pending_action", "pending_extra",
+                 "reward_acc", "total", "length", "rows")
+
+    def __init__(self):
+        self.pending_obs = None
+        self.pending_action = None
+        self.pending_extra = {}
+        self.reward_acc = 0.0
+        self.total = 0.0
+        self.length = 0
+        self.rows: list[dict] = []  # actor_critic: flushed at episode end
+
+
+class PolicyServerInput:
+    """TCP server collecting external-env experience; EnvRunner-shaped.
+
+    Modes mirror EnvRunner's: `epsilon_greedy` (DQN family — Q argmax with
+    annealed exploration) and `actor_critic` (PPO family — categorical
+    sampling with logp/value records). Transitions complete when the NEXT
+    observation arrives (get_action or end_episode), identical to how the
+    reference's server buffers `SampleBatch` rows.
+    """
+
+    def __init__(self, port: int, obs_dim: int, num_actions: int,
+                 module_factory, rollout_length: int = 64,
+                 mode: str = "epsilon_greedy", host: str = "127.0.0.1",
+                 seed: int = 0):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.rollout_length = rollout_length
+        self.mode = mode
+        self.module = module_factory(obs_dim, num_actions)
+        if getattr(self.module, "is_recurrent", False):
+            raise ValueError(
+                "PolicyServerInput does not support recurrent modules: "
+                "per-episode hidden state threading + stored-state replay "
+                "keys (state_in/resets) are not plumbed through the wire "
+                "protocol. Use an in-process EnvRunner for R2D2-family "
+                "algorithms.")
+        self.epsilon = 1.0
+        self._params = None
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Condition()
+        self._episodes: dict[str, _Episode] = {}
+        self._transitions: list[dict] = []
+        self._returns: list[float] = []
+        self._lengths: list[int] = []
+        self._closed = False
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="policy-server-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- EnvRunner surface --
+
+    def env_info(self) -> dict:
+        return {
+            "observation_dim": self.obs_dim,
+            "num_actions": self.num_actions,
+            "continuous": False,
+            "action_dim": 0,
+            "action_bound": 1.0,
+        }
+
+    def set_weights(self, params, epsilon: float | None = None) -> None:
+        with self._lock:
+            self._params = params
+            if epsilon is not None:
+                self.epsilon = epsilon
+
+    def get_state(self) -> dict:
+        return {"epsilon": self.epsilon}
+
+    def set_state(self, state: dict) -> None:
+        self.epsilon = state["epsilon"]
+
+    def sample(self, timeout_s: float = 300.0) -> dict:
+        """Block until one rollout's worth of external transitions arrived;
+        shape them [T, E=1] exactly like EnvRunner.sample()."""
+        T = self.rollout_length
+        with self._lock:
+            if not self._lock.wait_for(
+                    lambda: len(self._transitions) >= T or self._closed,
+                    timeout=timeout_s):
+                raise TimeoutError(
+                    f"no external experience: {len(self._transitions)}/{T} "
+                    f"transitions after {timeout_s}s — is a PolicyClient "
+                    "loop running?")
+            rows, self._transitions = (self._transitions[:T],
+                                       self._transitions[T:])
+            returns, self._returns = self._returns, []
+            lengths, self._lengths = self._lengths, []
+        batch = {
+            "obs": np.stack([r["obs"] for r in rows])[:, None, :],
+            "actions": np.asarray([r["action"] for r in rows],
+                                  np.int32)[:, None],
+            "rewards": np.asarray([r["reward"] for r in rows],
+                                  np.float32)[:, None],
+            "dones": np.asarray([r["done"] for r in rows], np.bool_)[:, None],
+            "terminateds": np.asarray([r["done"] for r in rows],
+                                      np.bool_)[:, None],
+            "episode_returns": np.asarray(returns, np.float32),
+            "episode_lengths": np.asarray(lengths, np.int64),
+        }
+        if self.mode == "actor_critic":
+            batch["logp"] = np.asarray([r["logp"] for r in rows],
+                                       np.float32)[:, None]
+            batch["values"] = np.asarray([r["value"] for r in rows],
+                                         np.float32)[:, None]
+            boot = np.asarray([r["bootstrap_value"] for r in rows],
+                              np.float32)[:, None]
+            batch["bootstrap_values"] = boot
+            with self._lock:
+                params = self._params
+            # V of the stream's next pending obs (or 0 if at a boundary)
+            nxt = rows[-1].get("next_obs")
+            if rows[-1]["done"] or nxt is None:
+                batch["last_values"] = np.zeros(1, np.float32)
+            else:
+                _, v = self.module.forward_np(params, nxt[None, :])
+                batch["last_values"] = v.astype(np.float32)
+        else:
+            batch["next_obs"] = np.stack(
+                [r["next_obs"] for r in rows])[:, None, :]
+        return batch
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- wire handling --
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_client, args=(conn,),
+                             name="policy-server-conn", daemon=True).start()
+
+    def _serve_client(self, conn: socket.socket) -> None:
+        file = conn.makefile("rwb")
+        try:
+            for line in file:
+                try:
+                    resp = self._handle(json.loads(line))
+                except Exception as exc:  # noqa: BLE001 — report to client
+                    resp = {"error": f"{type(exc).__name__}: {exc}"}
+                file.write(json.dumps(resp).encode() + b"\n")
+                file.flush()
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg: dict) -> dict:
+        cmd = msg.get("cmd")
+        if cmd == "start_episode":
+            eid = uuid.uuid4().hex[:12]
+            with self._lock:
+                self._episodes[eid] = _Episode()
+            return {"episode_id": eid}
+        eid = msg.get("episode_id")
+        with self._lock:
+            ep = self._episodes.get(eid)
+            if ep is None:
+                return {"error": f"unknown episode_id {eid!r}"}
+            if cmd == "log_returns":
+                ep.reward_acc += msg["reward"]
+                ep.total += msg["reward"]
+                return {"ok": True}
+            obs = np.asarray(msg["obs"], np.float32)
+            if obs.shape != (self.obs_dim,):
+                return {"error": f"obs shape {obs.shape} != ({self.obs_dim},)"}
+            if cmd == "end_episode":
+                self._complete_pending(ep, obs, done=True)
+                # actor_critic rows flush per-episode so concurrent
+                # clients' episodes stay temporally contiguous in the
+                # stream (GAE walks adjacent rows)
+                self._transitions.extend(ep.rows)
+                self._returns.append(ep.total)
+                self._lengths.append(ep.length)
+                del self._episodes[eid]
+                self._lock.notify_all()
+                return {"ok": True}
+            if cmd != "get_action":
+                return {"error": f"unknown cmd {cmd!r}"}
+            self._complete_pending(ep, obs, done=False)
+            params, epsilon = self._params, self.epsilon
+        # inference OUTSIDE the lock: a slow forward must not serialize
+        # other clients or block the trainer's sample()/set_weights
+        action, extra = self._infer(params, epsilon, obs)
+        with self._lock:
+            ep.pending_obs = obs
+            ep.pending_action = action
+            ep.pending_extra = extra
+            ep.length += 1
+        return {"action": action}
+
+    def _infer(self, params, epsilon: float, obs: np.ndarray):
+        """Action + per-step extras under a weight snapshot (no lock)."""
+        if params is None:
+            return int(self._rng.integers(self.num_actions)), {}
+        if self.mode == "actor_critic":
+            actions, logp, values = self.module.sample_actions_np(
+                params, obs[None, :], self._rng)
+            return int(actions[0]), {"logp": float(logp[0]),
+                                     "value": float(values[0])}
+        q = self.module.forward_np(params, obs[None, :])
+        if self._rng.uniform() < epsilon:
+            return int(self._rng.integers(self.num_actions)), {}
+        return int(np.argmax(q[0])), {}
+
+    def _complete_pending(self, ep: _Episode, next_obs: np.ndarray,
+                          done: bool) -> None:
+        """The transition for the PREVIOUS action completes now that its
+        successor observation arrived (lock held)."""
+        if ep.pending_obs is None:
+            return
+        row = {
+            "obs": ep.pending_obs,
+            "action": ep.pending_action,
+            "reward": ep.reward_acc,
+            "next_obs": next_obs,
+            "done": done,
+        }
+        if self.mode == "actor_critic":
+            row["logp"] = ep.pending_extra.get("logp", 0.0)
+            row["value"] = ep.pending_extra.get("value", 0.0)
+            boot = 0.0
+            if done and self._params is not None:
+                # external ends are treated as termination; the value of
+                # the final obs still rides along for GAE truncation use
+                _, v = self.module.forward_np(self._params, next_obs[None, :])
+                boot = float(v[0])
+            row["bootstrap_value"] = boot
+        ep.reward_acc = 0.0
+        ep.pending_obs = None
+        if self.mode == "actor_critic":
+            # held until end_episode so multi-client episodes don't
+            # interleave mid-episode in the advantage stream
+            ep.rows.append(row)
+        else:
+            self._transitions.append(row)
+            self._lock.notify_all()

@@ -111,3 +111,61 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         pr = np.abs(td_abs) + self.eps
         self._priorities[indices] = pr
         self._max_priority = max(self._max_priority, float(pr.max()))
+
+
+class SequenceReplayBuffer:
+    """Fixed-length sequence storage for recurrent replay (R2D2;
+    reference: rllib/utils/replay_buffers — R2D2 stores `replay_sequence
+    _length` windows with `replay_zero_init_states=False`, i.e. the
+    runner's stored hidden state rides with each sequence, Kapturowski
+    et al. 2019 'stored state'). Each row is one env's full rollout window:
+    obs [T, D], actions/rewards/dones/terminateds [T], resets [T] (step
+    starts a new episode), state_in [H] (hidden state at the window start).
+    """
+
+    def __init__(self, capacity: int, seq_len: int, obs_dim: int,
+                 state_dim: int, seed: int = 0):
+        self.capacity = capacity
+        self.seq_len = seq_len
+        self._rng = np.random.default_rng(seed)
+        self._obs = np.empty((capacity, seq_len, obs_dim), np.float32)
+        self._actions = np.empty((capacity, seq_len), np.int32)
+        self._rewards = np.empty((capacity, seq_len), np.float32)
+        self._dones = np.empty((capacity, seq_len), np.bool_)
+        self._terminated = np.empty((capacity, seq_len), np.bool_)
+        self._resets = np.empty((capacity, seq_len), np.bool_)
+        self._state_in = np.empty((capacity, state_dim), np.float32)
+        self._size = 0
+        self._head = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_rollout(self, batch: dict) -> None:
+        """Store a [T, E] EnvRunner batch as E sequences."""
+        T, E = batch["rewards"].shape
+        if T != self.seq_len:
+            raise ValueError(f"rollout length {T} != buffer seq_len {self.seq_len}")
+        for e in range(E):
+            i = self._head
+            self._obs[i] = batch["obs"][:, e]
+            self._actions[i] = batch["actions"][:, e]
+            self._rewards[i] = batch["rewards"][:, e]
+            self._dones[i] = batch["dones"][:, e]
+            self._terminated[i] = batch["terminateds"][:, e]
+            self._resets[i] = batch["resets"][:, e]
+            self._state_in[i] = batch["state_in"][e]
+            self._head = (self._head + 1) % self.capacity
+            self._size = min(self._size + 1, self.capacity)
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {
+            "obs": self._obs[idx],
+            "actions": self._actions[idx],
+            "rewards": self._rewards[idx],
+            "dones": self._dones[idx],
+            "terminateds": self._terminated[idx],
+            "resets": self._resets[idx],
+            "state_in": self._state_in[idx],
+        }

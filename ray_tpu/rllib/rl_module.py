@@ -212,6 +212,111 @@ class DeterministicPolicyModule:
         return _mlp_jax(params[head], x)[:, 0]
 
 
+def _gru_init(rng: np.random.Generator, n_in: int, hidden: int) -> dict:
+    """GRU cell params: fused r/z/n gates ([n_in,3H] + [H,3H] + [3H])."""
+    scale_x = np.sqrt(1.0 / n_in)
+    scale_h = np.sqrt(1.0 / hidden)
+    return {
+        "wx": (rng.standard_normal((n_in, 3 * hidden)) * scale_x).astype(np.float32),
+        "wh": (rng.standard_normal((hidden, 3 * hidden)) * scale_h).astype(np.float32),
+        "b": np.zeros(3 * hidden, np.float32),
+    }
+
+
+def _gru_step(xp, cell, x, h):
+    """One GRU step in either numpy or jax (xp = np | jnp). Gate order
+    r, z, n; h' = (1-z)*n + z*h (Cho et al. 2014, the torch convention the
+    reference's recurrent_net.py wraps)."""
+    H = h.shape[-1]
+    gx = x @ cell["wx"] + cell["b"]
+    gh = h @ cell["wh"]
+    r = 1.0 / (1.0 + xp.exp(-(gx[..., :H] + gh[..., :H])))
+    z = 1.0 / (1.0 + xp.exp(-(gx[..., H:2 * H] + gh[..., H:2 * H])))
+    n = xp.tanh(gx[..., 2 * H:] + r * gh[..., 2 * H:])
+    return (1.0 - z) * n + z * h
+
+
+class RecurrentQModule:
+    """GRU Q-network for partially observable envs — the R2D2 model
+    (reference: rllib/models/torch/recurrent_net.py LSTMWrapper;
+    rllib_contrib/r2d2 uses it over the DQN head). Encoder MLP -> GRU ->
+    Q head. Two paths over the same params:
+
+      * `step_np` — one timestep, numpy, carrying explicit state
+        (EnvRunner rollouts; the runner owns per-env state rows).
+      * `forward_seq` — jax `lax.scan` over [B, T] sequences with
+        start-of-episode state resets, used inside the jitted learner
+        update (compiler-friendly: one scan, static shapes).
+    """
+
+    is_recurrent = True
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64,), rnn_hidden: int = 64):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+        self.rnn_hidden = rnn_hidden
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        dims = [self.obs_dim, *self.hidden]
+        enc = [
+            _init_linear(rng, dims[i], dims[i + 1], np.sqrt(2))
+            for i in range(len(dims) - 1)
+        ]
+        return {
+            "enc": enc,
+            "gru": _gru_init(rng, dims[-1], self.rnn_hidden),
+            "q": [_init_linear(rng, self.rnn_hidden, self.num_actions, 0.01)],
+        }
+
+    def initial_state(self, batch_size: int) -> np.ndarray:
+        return np.zeros((batch_size, self.rnn_hidden), np.float32)
+
+    def _encode_np(self, params, obs):
+        h = obs
+        for layer in params["enc"]:
+            h = np.tanh(h @ layer["w"] + layer["b"])
+        return h
+
+    def step_np(self, params, obs: np.ndarray, state: np.ndarray):
+        """(q [B, A], next_state [B, H]) — one rollout timestep."""
+        x = self._encode_np(params, obs)
+        h = _gru_step(np, params["gru"], x, state)
+        head = params["q"][0]
+        return h @ head["w"] + head["b"], h
+
+    # EnvRunner's epsilon-greedy branch calls forward_np; for a recurrent
+    # module the runner routes through step_np instead (state threading).
+
+    def forward_seq(self, params, obs, state0, resets):
+        """jax: obs [B, T, D], state0 [B, H], resets [B, T] (True = zero the
+        state BEFORE consuming step t, i.e. t starts a new episode) ->
+        (q [B, T, A], final_state [B, H])."""
+        import jax
+        import jax.numpy as jnp
+
+        def encode(x):
+            for layer in params["enc"]:
+                x = jnp.tanh(x @ layer["w"] + layer["b"])
+            return x
+
+        x_seq = encode(obs)                      # [B, T, hidden[-1]]
+
+        def scan_step(h, inputs):
+            x_t, reset_t = inputs
+            h = jnp.where(reset_t[:, None], 0.0, h)
+            h = _gru_step(jnp, params["gru"], x_t, h)
+            return h, h
+
+        xs = (jnp.swapaxes(x_seq, 0, 1), jnp.swapaxes(resets, 0, 1))
+        h_final, h_seq = jax.lax.scan(scan_step, state0, xs)
+        h_seq = jnp.swapaxes(h_seq, 0, 1)        # [B, T, H]
+        head = params["q"][0]
+        return h_seq @ head["w"] + head["b"], h_final
+
+
 def _conv2d_np(x: np.ndarray, w: np.ndarray, b: np.ndarray) -> np.ndarray:
     """SAME-padded 3x3 conv, NHWC, via im2col — the EnvRunner numpy path
     for conv policies (rollout batches are small; matmul via BLAS)."""
