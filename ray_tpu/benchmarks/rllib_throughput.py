@@ -59,9 +59,33 @@ def main() -> None:
         .debugging(seed=0),
         conv_iters,
     )
+    # rollout-only conv rate: isolates the EnvRunner path (jitted CPU
+    # inference + batched boundary bootstraps) from the learner's conv
+    # gradients, which on a 1-core CPU box dominate total time but run
+    # on the TPU in production
+    from ray_tpu.rllib.env_runner import EnvRunner
+    from ray_tpu.rllib.rl_module import ConvActorCriticModule
+
+    runner = EnvRunner(
+        "MiniBreakout",
+        lambda d, a: ConvActorCriticModule(d, a, frame_shape=(10, 10, 4)),
+        num_envs=8, rollout_length=128, seed=0)
+    w = runner.module.init(0)
+    runner.set_weights(w)
+    runner.sample()  # warm the per-step jit shape
+    # warm every bootstrap bucket the padded boundary batch can hit, so
+    # a timed rollout crossing a power-of-two bucket never pays a fresh
+    # XLA compile inside the clock
+    import numpy as np
+    for bucket in (32, 64, 128, 256, 512, 1024):
+        runner.module.forward_np(w, np.zeros((bucket, 400), np.float32))
+    t0 = time.perf_counter()
+    n = sum(runner.sample()["rewards"].size for _ in range(4))
+    conv_rollout_rate = n / (time.perf_counter() - t0)
     print(json.dumps({
         "ppo_env_steps_per_sec": round(mlp_rate, 1),
         "ppo_conv_env_steps_per_sec": round(conv_rate, 1),
+        "ppo_conv_rollout_only_steps_per_sec": round(conv_rollout_rate, 1),
         "episode_return_mean": round(last.get("episode_return_mean", 0.0), 1),
         "iterations": ITERATIONS,
         "conv_iterations": conv_iters,

@@ -332,11 +332,17 @@ def run_all_to_all_pipelined(
             refs = [refs]
         map_out.append(list(refs))
         inflight.append(refs[0])
-        if len(inflight) >= window:
+        while len(inflight) >= window:
             # bounded in-flight maps: wait for any to land before pulling
-            # more input (backpressure against a fast upstream)
+            # more input (backpressure against a fast upstream). Loop so a
+            # timeout can't silently grow the window; zero progress raises
+            # like the reduce phase below.
             ready, inflight = ray_tpu.wait(inflight, num_returns=1,
                                            timeout=600)
+            if not ready:
+                raise TimeoutError(
+                    "all-to-all map phase made no progress for 600s "
+                    f"({len(inflight)} shuffle maps outstanding)")
     n_in = len(map_out)
     if n_in == 0:
         return

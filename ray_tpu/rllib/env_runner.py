@@ -107,6 +107,7 @@ class EnvRunner:
             # episode (t=0 rows are continuations unless state_in is zero)
             batch["state_in"] = self._h.copy()
             batch["resets"] = np.zeros((T, E), np.bool_)
+        pending_boots: list[tuple] = []  # (t, done_mask, done rows' obs)
         for t in range(T):
             obs = self.pipeline(self.vec.obs)
             batch["obs"][t] = obs
@@ -153,10 +154,11 @@ class EnvRunner:
                 if dones.any():
                     # peek: processed successor obs WITHOUT advancing
                     # connector state (the real next pipeline step happens
-                    # on the auto-reset obs)
+                    # on the auto-reset obs). Deferred: boundary rows are
+                    # batched into ONE forward after the loop — per-step
+                    # value calls were the conv rollout bottleneck.
                     proc_next = self.pipeline.peek(true_next_obs)
-                    _, v_true = self.module.forward_np(self._params, proc_next)
-                    batch["bootstrap_values"][t] = np.where(dones, v_true, 0.0)
+                    pending_boots.append((t, dones.copy(), proc_next[dones]))
             else:
                 batch["next_obs"][t] = self.pipeline.peek(true_next_obs)
             if self._recurrent:
@@ -171,6 +173,23 @@ class EnvRunner:
             _, last_values = self.module.forward_np(
                 self._params, self.pipeline.peek(self.vec.obs))
             batch["last_values"] = last_values.astype(np.float32)
+            if pending_boots:
+                rows = np.concatenate([r for _, _, r in pending_boots])
+                n_rows = len(rows)
+                # pad to a power-of-two bucket: a jitted forward recompiles
+                # per input shape, and the boundary count varies per rollout
+                bucket = 1 << (n_rows - 1).bit_length()
+                if bucket != n_rows:
+                    rows = np.concatenate(
+                        [rows, np.zeros((bucket - n_rows, rows.shape[1]),
+                                        rows.dtype)])
+                _, v_all = self.module.forward_np(self._params, rows)
+                v_all = v_all[:n_rows]
+                off = 0
+                for t, dones, r in pending_boots:
+                    n = len(r)
+                    batch["bootstrap_values"][t][dones] = v_all[off:off + n]
+                    off += n
         returns, lengths = self.vec.pop_episode_stats()
         batch["episode_returns"] = np.asarray(returns, np.float32)
         batch["episode_lengths"] = np.asarray(lengths, np.int64)

@@ -93,6 +93,13 @@ class CQL(Algorithm):
         obs, actions, rewards, next_obs, term = [], [], [], [], []
         for ep in reader.episodes():
             for i, row in enumerate(ep):
+                terminated = bool(row.get("terminated", row["done"]))
+                if i + 1 == len(ep) and not terminated:
+                    # episode-final TRUNCATED row: no successor obs was
+                    # logged, and marking it terminal would bias Q-targets
+                    # low on time-limited envs (the reference distinguishes
+                    # terminated from truncated) — drop the transition
+                    continue
                 obs.append(row["obs"])
                 actions.append(row["action"])
                 rewards.append(row["reward"])
@@ -100,8 +107,7 @@ class CQL(Algorithm):
                     next_obs.append(ep[i + 1]["obs"])
                 else:
                     next_obs.append(row["obs"])  # terminal: masked below
-                term.append(bool(row.get("terminated", row["done"])) or
-                            i + 1 == len(ep))
+                term.append(terminated)
         if not actions:
             raise ValueError("offline input is empty")
         self._obs = np.asarray(obs, np.float32)

@@ -351,6 +351,12 @@ class ConvActorCriticModule:
         self.frame_shape = tuple(frame_shape)
         self.channels = tuple(channels)
         self.hidden = hidden
+        # rollout-inference jit cache (lazy; never pickled with the module
+        # factory — runners build their module in-process). _jit_ok caches
+        # the use-jax-or-not decision so the numpy fallback never re-probes.
+        self._jit_fwd = None
+        self._dev_params = None
+        self._jit_ok: bool | None = None
 
     def init(self, seed: int = 0) -> dict:
         rng = np.random.default_rng(seed)
@@ -384,9 +390,74 @@ class ConvActorCriticModule:
         return np.tanh(h @ t["w"] + t["b"])
 
     def forward_np(self, params: dict, obs: np.ndarray):
+        """Rollout inference through a CPU-jitted forward: XLA's fused
+        conv stack is ~10x the interpreted im2col path, which made conv
+        rollouts the EnvRunner bottleneck (2.1k steps/s vs 33k for the
+        MLP). The computation is pinned to the host CPU device so runner
+        processes never touch the learner's TPU; params transfer once per
+        weight broadcast (cached by identity), not per step. Falls back to
+        the numpy im2col path wherever jax cannot be used safely (see
+        _jit_usable)."""
+        if self._jit_ok or (self._jit_ok is None and self._jit_usable()):
+            return self._forward_jit(params, obs)
         h = self._trunk_np(params, obs)
         pi, vf = params["pi"][0], params["vf"][0]
         return h @ pi["w"] + pi["b"], (h @ vf["w"] + vf["b"])[:, 0]
+
+    def _jit_usable(self) -> bool:
+        """Decide ONCE whether this process may run the jitted path.
+
+        Initializing jax backends is not free of side effects: on a TPU
+        host, accelerator discovery can hang on a stalled tunnel or
+        exclusively seize the learner's chip (libtpu is single-process) —
+        and merely having `jax` in sys.modules proves nothing, because
+        the image's sitecustomize imports jax into EVERY process without
+        initializing backends. Policy, decided once per module:
+
+          * backends already initialized in this process (the learner, a
+            prior jax task) -> safe: `jax.devices("cpu")` reads a cache.
+          * backends uninitialized but the platform config is CPU-only
+            -> safe: init cannot probe an accelerator.
+          * backends uninitialized in a ray_tpu WORKER process (rollout
+            actor) -> pin the process to the CPU backend first; rollout
+            actors never legitimately need the TPU.
+          * anything else (fresh driver/plain process with accelerator
+            platforms configured) -> numpy fallback; a rollout must not
+            be what initializes TPU backends.
+        """
+        try:
+            import jax
+            from jax._src import xla_bridge
+
+            initialized = bool(getattr(xla_bridge, "_backends", None))
+            if not initialized:
+                plat = jax.config.jax_platforms or ""
+                cpu_only = plat and set(plat.split(",")) <= {"cpu"}
+                if not cpu_only:
+                    from ray_tpu._private import worker as _worker_mod
+
+                    gw = _worker_mod._global_worker
+                    if gw is None or gw.mode != "worker":
+                        self._jit_ok = False
+                        return False
+                    jax.config.update("jax_platforms", "cpu")
+            self._jit_fwd = (jax.jit(self.forward), jax.devices("cpu")[0])
+            self._jit_ok = True
+        except Exception:  # noqa: BLE001 — any jax trouble -> numpy path
+            self._jit_ok = False
+        return self._jit_ok
+
+    def _forward_jit(self, params: dict, obs: np.ndarray):
+        import jax
+
+        fwd, cpu = self._jit_fwd
+        if self._dev_params is None or self._dev_params[0] is not params:
+            dev = jax.tree_util.tree_map(
+                lambda x: jax.device_put(np.asarray(x), cpu), params)
+            self._dev_params = (params, dev)
+        logits, values = fwd(self._dev_params[1],
+                             jax.device_put(np.asarray(obs), cpu))
+        return np.asarray(logits), np.asarray(values)
 
     sample_actions_np = ActorCriticModule.sample_actions_np
 

@@ -71,11 +71,15 @@ class DeploymentResponseGenerator:
     DeploymentResponseGenerator). Values (not refs) are yielded — the
     handle resolves each chunk as it arrives."""
 
-    def __init__(self, ref_gen, on_done=None):
+    def __init__(self, ref_gen, on_done=None,
+                 chunk_timeout_s: float | None = 120.0):
         self._ref_gen = ref_gen
         self._on_done = on_done
         self._done = False
-        self._timeout = 120.0
+        # per-chunk fetch budget; None = wait forever (slow LLM prefill /
+        # long tool calls can legitimately exceed any fixed gap). Set via
+        # handle.options(stream_chunk_timeout_s=...).
+        self._timeout = chunk_timeout_s
 
     def __iter__(self):
         return self
@@ -224,7 +228,9 @@ class _Router:
 
     # -- call paths --
 
-    def call(self, method_name: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+    def call(self, method_name: str, args: tuple, kwargs: dict,
+             options: dict | None = None) -> DeploymentResponse:
+        options = options or {}
         self._refresh()
         with self._lock:
             bc = self._batch_configs.get(method_name)
@@ -252,7 +258,8 @@ class _Router:
                 self._inflight[aid] = self._inflight.get(aid, 0) + 1
                 self._outstanding[oid] = aid
             return DeploymentResponseGenerator(
-                gen, on_done=lambda: self._decrement(oid))
+                gen, on_done=lambda: self._decrement(oid),
+                chunk_timeout_s=options.get("stream_chunk_timeout_s", 120.0))
         ref = replica.rt_call.remote(method_name, args, kwargs)
         oid = ref.object_id.binary()
         with self._lock:
@@ -261,24 +268,43 @@ class _Router:
         return DeploymentResponse(ref=ref, on_done=lambda: self._decrement(oid))
 
 class _HandleMethod:
-    def __init__(self, router: _Router, method_name: str):
+    def __init__(self, router: _Router, method_name: str,
+                 options: dict | None = None):
         self._router = router
         self._method_name = method_name
+        self._options = options
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        return self._router.call(self._method_name, args, kwargs)
+        return self._router.call(self._method_name, args, kwargs,
+                                 options=self._options)
 
 
 class DeploymentHandle:
     """Callable handle to a deployment; picklable (rebuilds its router from
     the named controller on the other side)."""
 
-    def __init__(self, deployment_name: str, app_name: str = "default"):
+    _OPTION_KEYS = frozenset({"stream_chunk_timeout_s"})
+
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 _options: dict | None = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
+        self._handle_options = _options or {}
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.app_name))
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._handle_options))
+
+    def options(self, **kwargs) -> "DeploymentHandle":
+        """Per-call options on a derived handle (reference:
+        serve/handle.py DeploymentHandle.options). Supported:
+        stream_chunk_timeout_s — per-chunk fetch budget for generator
+        methods (None waits forever)."""
+        unknown = set(kwargs) - self._OPTION_KEYS
+        if unknown:
+            raise TypeError(f"unknown handle options: {sorted(unknown)}")
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                {**self._handle_options, **kwargs})
 
     @property
     def _router(self) -> _Router:
@@ -287,7 +313,8 @@ class DeploymentHandle:
     def __getattr__(self, name: str) -> _HandleMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return _HandleMethod(self._router, name)
+        return _HandleMethod(self._router, name, self._handle_options)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        return self._router.call("__call__", args, kwargs)
+        return self._router.call("__call__", args, kwargs,
+                                 options=self._handle_options)

@@ -276,7 +276,11 @@ class HyperBandScheduler(TrialScheduler):
         self._live.add(tid)
         t = result.get(self.time_attr, trial.iteration)
         if t >= self.max_t:
+            # fully retire the trial: a stale _scores/_paused entry would
+            # let a dead trial occupy a keep slot at the next barrier cut
             self._live.discard(tid)
+            self._scores.pop(tid, None)
+            self._paused.discard(tid)
             self._maybe_cut()
             return STOP
         if t < self.milestone:
@@ -296,6 +300,8 @@ class HyperBandScheduler(TrialScheduler):
 
     def on_trial_complete(self, trial: Trial) -> None:
         self._live.discard(trial.trial_id)
+        self._scores.pop(trial.trial_id, None)
+        self._paused.discard(trial.trial_id)
         self._maybe_cut()
 
     def _maybe_cut(self) -> bool:

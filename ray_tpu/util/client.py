@@ -349,7 +349,16 @@ class _ReconnectingRpc:
             return self._rpc.call(method, payload, timeout=timeout)
 
     def call_async(self, method: str, payload: Any = None):
-        return self._rpc.call_async(method, payload)
+        """Fire-and-forget sends share call()'s session guarantees: refuse
+        after a lost session, and heal-then-retry once on a dead socket so
+        async users don't silently bypass the reclaim path."""
+        if self._session_lost:
+            raise ConnectionError(self._LOST_MSG)
+        try:
+            return self._rpc.call_async(method, payload)
+        except ConnectionError:
+            self._heal()
+            return self._rpc.call_async(method, payload)
 
     def close(self) -> None:
         try:

@@ -77,6 +77,37 @@ def test_sync_hyperband_unit_barrier_semantics():
     assert "nockpt" in sched._scores
 
 
+def test_sync_hyperband_retires_dead_trials_from_ranking():
+    """A trial that hits max_t (or completes) must not keep occupying a
+    keep slot at later barrier cuts with its stale milestone score."""
+    from ray_tpu.tune.schedulers import (
+        CONTINUE, STOP, HyperBandScheduler,
+    )
+    from ray_tpu.tune.trial import Trial
+
+    sched = HyperBandScheduler(grace_period=4, reduction_factor=2, max_t=8)
+    sched.set_search_properties("score", "max")
+    trials = {}
+    for tid in ("champ", "a", "b"):
+        t = Trial(config={}, experiment_dir="/tmp", trial_id=tid)
+        t.checkpoint_path = f"/tmp/ckpt-{tid}"
+        trials[tid] = t
+        sched.on_trial_add(t)
+    # champ posts the top score at the milestone, then hits max_t: retired
+    sched.on_trial_result(trials["champ"], {"training_iteration": 4, "score": 99})
+    assert sched.on_trial_result(
+        trials["champ"], {"training_iteration": 8, "score": 99}) == STOP
+    assert "champ" not in sched._scores
+    # the cut over the two LIVE trials keeps ceil(2/2)=1: `a` must win a
+    # keep slot — with champ's stale 99 still ranked, `a` would be cut
+    sched.on_trial_result(trials["a"], {"training_iteration": 4, "score": 5})
+    verdict_b = sched.on_trial_result(
+        trials["b"], {"training_iteration": 4, "score": 1})
+    assert verdict_b == STOP
+    actions = sched.pending_actions()
+    assert actions.get("a") == "RESUME", actions
+
+
 def test_pb2_explores_within_bounds_and_learns(ray_start):
     from ray_tpu import tune
 
