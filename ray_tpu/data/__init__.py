@@ -12,6 +12,7 @@ from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.datasource import (
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
@@ -19,11 +20,13 @@ from ray_tpu.data.datasource import (
     range_tensor,
     read_csv,
     read_binary_files,
+    read_huggingface,
     read_images,
     read_json,
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
 )
 
 __all__ = [
@@ -35,6 +38,7 @@ __all__ = [
     "Dataset",
     "GroupedData",
     "from_arrow",
+    "from_huggingface",
     "from_items",
     "from_numpy",
     "from_pandas",
@@ -42,11 +46,13 @@ __all__ = [
     "range_tensor",
     "read_csv",
     "read_binary_files",
+    "read_huggingface",
     "read_images",
     "read_json",
     "read_numpy",
     "read_parquet",
     "read_text",
+    "read_tfrecords",
 ]
 
 

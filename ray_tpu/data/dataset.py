@@ -653,6 +653,16 @@ class Dataset:
 
         return self._write(path, w, "json")
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        """Rows -> tf.train.Example TFRecord files (reference:
+        dataset.py write_tfrecords), via the in-tree tf-free codec."""
+        def w(t, p):
+            from ray_tpu.data.tfrecord import encode_example, write_records
+
+            write_records(p, (encode_example(row) for row in t.to_pylist()))
+
+        return self._write(path, w, "tfrecords")
+
     # -- misc ---------------------------------------------------------------
 
     def stats(self) -> str:

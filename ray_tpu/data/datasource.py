@@ -192,6 +192,81 @@ def read_images(paths, *, size: Optional[tuple] = None,
     return Dataset([_Read(files, read)])
 
 
+def read_tfrecords(paths, *, verify_crc: bool = True,
+                   parallelism: int = -1) -> Dataset:
+    """TFRecord files of tf.train.Example records — the standard TPU
+    training-corpus format (reference: datasource/tfrecords_datasource.py).
+    No TensorFlow dependency: framing and Example protobufs are decoded
+    in-tree (ray_tpu/data/tfrecord.py). Single-element lists unwrap to
+    scalars, matching the reference's read behavior; bytes stay bytes."""
+    files = _resolve_paths(paths)
+
+    def read(path) -> pa.Table:
+        from ray_tpu.data.tfrecord import decode_example, read_records
+
+        rows = []
+        for payload in read_records(path, verify_crc=verify_crc):
+            row = {}
+            for key, values in decode_example(payload).items():
+                row[key] = values[0] if len(values) == 1 else values
+            rows.append(row)
+        return pa.Table.from_pylist(rows) if rows else pa.table({})
+
+    return Dataset([_Read(files, read)])
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """A `datasets.Dataset` (in-memory arrow) -> Dataset (reference:
+    read_api.py from_huggingface / huggingface_datasource.py). Requires
+    the `datasets` package only in the sense that you already have one of
+    its objects; conversion rides its public arrow surface."""
+    if getattr(hf_dataset, "_indices", None) is not None:
+        # select/filter/shuffle/train_test_split record their row mapping
+        # in _indices while .data keeps the FULL table — materialize the
+        # selection first or we'd return rows the user filtered out
+        hf_dataset = hf_dataset.flatten_indices()
+    table = getattr(getattr(hf_dataset, "data", None), "table", None)
+    if table is None:
+        # older/newer datasets versions: .data may BE the table, or fall
+        # back to arrow export
+        table = getattr(hf_dataset, "data", None)
+        if not isinstance(table, pa.Table):
+            if hasattr(hf_dataset, "to_pandas"):
+                return from_pandas(hf_dataset.to_pandas())
+            raise TypeError(
+                f"cannot extract an arrow table from {type(hf_dataset)!r}")
+    return from_arrow(table.combine_chunks())
+
+
+def read_huggingface(path: str) -> Dataset:
+    """A `datasets.Dataset.save_to_disk()` directory -> Dataset. The
+    on-disk layout is arrow IPC stream files (data-*.arrow) + json
+    manifests, so this reads WITHOUT the datasets package installed;
+    when it is importable, load_from_disk handles layout variations."""
+    try:
+        import datasets  # noqa: F401 — prefer the native loader
+
+        return from_huggingface(datasets.load_from_disk(path))
+    except ImportError:
+        pass
+    files = [p for p in _resolve_paths(path) if p.endswith(".arrow")]
+    if not files:
+        raise FileNotFoundError(
+            f"no .arrow data files under {path!r} — not a saved HF dataset?")
+
+    def read(p) -> pa.Table:
+        import pyarrow.ipc as ipc
+
+        with open(p, "rb") as f:
+            try:
+                return ipc.open_stream(f).read_all()
+            except pa.ArrowInvalid:
+                f.seek(0)
+                return ipc.open_file(f).read_all()
+
+    return Dataset([_Read(files, read)])
+
+
 def read_binary_files(paths, *, include_paths: bool = False,
                       parallelism: int = -1) -> Dataset:
     """One row per file with its raw bytes (reference:

@@ -107,9 +107,46 @@ def test_gbdt_trainer_classification_and_guard(ray_start):
     assert result.error is None, result.error
     assert result.metrics["train_accuracy"] > 0.9, result.metrics
 
-    with pytest.raises(ValueError, match="one training actor"):
-        GBDTTrainer(datasets={"train": ds}, label_column="label",
-                    scaling_config=ScalingConfig(num_workers=4))
+
+def test_gbdt_distributed_matches_single_worker_quality(ray_start):
+    """2 workers on a sharded dataset: split decisions come from allreduced
+    histograms, so distributed quality must match the single-worker fit
+    (reference: gbdt_trainer.py multi-actor boosting via xgboost-ray)."""
+    import tempfile
+
+    from ray_tpu import data
+    from ray_tpu.train import GBDTTrainer, RunConfig, ScalingConfig
+
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((600, 4)).astype(np.float32)
+    y = (1.5 * X[:, 0] - X[:, 1] + 0.1 * rng.standard_normal(600)).astype(
+        np.float32)
+    ds = data.from_items([
+        {"f0": X[i, 0], "f1": X[i, 1], "f2": X[i, 2], "f3": X[i, 3],
+         "label": y[i]} for i in range(600)
+    ])
+    kw = dict(
+        datasets={"train": ds}, label_column="label",
+        params={"max_depth": 4, "learning_rate": 0.2},
+        num_boost_round=40,
+    )
+    single = GBDTTrainer(
+        run_config=RunConfig(storage_path=tempfile.mkdtemp()), **kw).fit()
+    assert single.error is None, single.error
+    dist = GBDTTrainer(
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=tempfile.mkdtemp()), **kw).fit()
+    assert dist.error is None, dist.error
+    assert dist.metrics["backend"] == "ray_tpu-hist-allreduce"
+    assert dist.metrics["world_size"] == 2
+    assert dist.metrics["n_rows"] == 600  # global, not one shard
+    # distributed must reach single-worker quality (label std ~1.9)
+    assert dist.metrics["train_rmse"] < max(
+        0.6, 1.25 * single.metrics["train_rmse"]), (
+        single.metrics, dist.metrics)
+    model = GBDTTrainer.load_model(dist)
+    pred = model.predict(X.astype(np.float64))
+    assert float(np.sqrt(np.mean((pred - y) ** 2))) < 0.6
 
 
 def test_dask_tuple_keys_as_real_collections_use(ray_start):
