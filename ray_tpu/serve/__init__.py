@@ -11,6 +11,7 @@ from ray_tpu.serve.api import (
     get_app_handle,
     get_deployment_handle,
     grpc_port,
+    proxy_addresses,
     run,
     shutdown,
     start,
@@ -51,6 +52,7 @@ __all__ = [
     "grpc_port",
     "multiplexed",
     "pad_to_bucket",
+    "proxy_addresses",
     "run",
     "shutdown",
     "start",

@@ -36,11 +36,32 @@ def _get_or_create_controller():
 def start(
     http_options: HTTPOptions | dict | None = None,
     grpc_options: GrpcOptions | dict | None = None,
+    proxy_location: str = "Driver",
 ) -> None:
-    """Start serve system actors (controller + HTTP/gRPC proxies)
-    (reference: serve.start)."""
+    """Start serve system actors (reference: serve.start;
+    proxy_location mirrors serve.config.ProxyLocation).
+
+    proxy_location:
+      * "Driver" — dev mode: in-process proxy threads in this driver.
+      * "EveryNode" — production shape: the controller keeps one proxy
+        ACTOR per alive node, health-checked and restarted on failure
+        (reference: serve/_private/proxy_state.py). Use port=0 per
+        protocol unless nodes are distinct hosts; read bound ports via
+        serve.proxy_addresses().
+    """
     global _proxy, _grpc_proxy
-    _get_or_create_controller()
+    controller = _get_or_create_controller()
+    if proxy_location == "EveryNode":
+        ray_tpu.get(
+            controller.start_proxies.remote(
+                _as_dict(http_options), _as_dict(grpc_options)),
+            timeout=60,
+        )
+        return
+    if proxy_location != "Driver":
+        raise ValueError(
+            f"proxy_location must be 'Driver' or 'EveryNode', "
+            f"got {proxy_location!r}")
     if http_options is not None and _proxy is None:
         if isinstance(http_options, dict):
             http_options = HTTPOptions(**http_options)
@@ -51,6 +72,29 @@ def start(
             grpc_options = GrpcOptions(**grpc_options)
         _grpc_proxy = GrpcProxy(grpc_options)
         _grpc_proxy.start()
+
+
+def _as_dict(options) -> dict | None:
+    if options is None:
+        return None
+    if isinstance(options, dict):
+        return dict(options)
+    from dataclasses import asdict
+
+    return asdict(options)
+
+
+def proxy_addresses(timeout_s: float = 30.0) -> dict:
+    """hex node_id -> {"http": (host, port), ...} of HEALTHY per-node
+    proxies (EveryNode mode). Blocks briefly until at least one proxy is
+    up or the timeout passes."""
+    controller = _get_or_create_controller()
+    deadline = time.monotonic() + timeout_s
+    while True:
+        addrs = ray_tpu.get(controller.proxy_addresses.remote(), timeout=60)
+        if addrs or time.monotonic() > deadline:
+            return addrs
+        time.sleep(0.1)
 
 
 def run(

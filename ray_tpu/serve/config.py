@@ -74,8 +74,15 @@ class DeploymentConfig:
 
 @dataclass
 class HTTPOptions:
+    """HTTP ingress options (reference: serve/config.py HTTPOptions —
+    including request_timeout_s). port=0 binds an ephemeral port (exposed
+    as HTTPProxy.port / serve.proxy_addresses())."""
+
     host: str = "127.0.0.1"
     port: int = 8000
+    # end-to-end budget for a unary result and the per-chunk budget for
+    # streamed responses; None waits forever
+    request_timeout_s: float | None = 120.0
 
 
 @dataclass
@@ -86,3 +93,4 @@ class GrpcOptions:
 
     host: str = "127.0.0.1"
     port: int = 9000
+    request_timeout_s: float | None = 120.0

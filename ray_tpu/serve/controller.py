@@ -61,6 +61,19 @@ class _DeploymentState:
         self.deleted = False
 
 
+class _ProxyState:
+    """One node's proxy actor (reference: proxy_state.py ProxyState —
+    STARTING -> HEALTHY with ping-based health and restart-on-death)."""
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.state = "STARTING"  # STARTING | HEALTHY | UNHEALTHY
+        self.addresses: dict = {}
+        self.ping_ref = None
+        self.ping_deadline = 0.0
+        self.next_ping_at = 0.0
+
+
 class ServeController:
     """State-reconciling controller (runs as a named actor; methods are the
     RPC surface, a daemon thread is the control loop)."""
@@ -73,6 +86,11 @@ class ServeController:
         self._version = 0
         # router_id -> (ts, {(app, deployment): inflight})
         self._router_metrics: dict[str, tuple[float, dict]] = {}
+        # per-node ingress proxies (None until start_proxies): node_id(bytes)
+        # -> _ProxyState
+        self._proxy_cfg: tuple[dict | None, dict | None] | None = None
+        self._proxies: dict[bytes, _ProxyState] = {}
+        self._proxy_failures: dict[bytes, int] = {}
         self._stopped = threading.Event()
         self._reconcile_period_s = reconcile_period_s
         self._thread = threading.Thread(
@@ -190,16 +208,68 @@ class ServeController:
                 for app_name, app in self._apps.items()
             }
 
+    def start_proxies(self, http_options: dict | None,
+                      grpc_options: dict | None) -> None:
+        """Enable per-node ingress: the reconcile loop keeps one proxy
+        actor on every alive node (reference: ProxyStateManager.update).
+        Ports should be 0 (ephemeral) unless every node is a distinct
+        host; read the bound ports back via proxy_addresses(). Calling
+        again clears UNHEALTHY tombstones (crash-looped nodes retry)."""
+        with self._lock:
+            self._proxy_cfg = (http_options, grpc_options)
+            self._proxy_failures.clear()
+            for nid in [n for n, ps in self._proxies.items()
+                        if ps.state == "UNHEALTHY"]:
+                self._proxies.pop(nid)
+
+    def proxy_addresses(self) -> dict:
+        """hex node_id -> {"http": (host, port), "grpc": (host, port)}
+        for HEALTHY proxies."""
+        with self._lock:
+            return {
+                nid.hex(): dict(ps.addresses)
+                for nid, ps in self._proxies.items()
+                if ps.state == "HEALTHY"
+            }
+
+    def proxy_status(self) -> dict:
+        with self._lock:
+            return {nid.hex(): ps.state
+                    for nid, ps in self._proxies.items()}
+
     def shutdown(self) -> None:
         self._stopped.set()
         with self._lock:
             apps = list(self._apps.values())
             self._apps.clear()
+            proxies = list(self._proxies.values())
+            self._proxies.clear()
+            self._proxy_cfg = None
         for app in apps:
             for d in app["deployments"].values():
                 self._stop_replicas(d, len(d.replicas))
+        for ps in proxies:
+            if ps.handle is None:
+                continue
+            try:
+                ray_tpu.kill(ps.handle)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
 
     # ---------------- reconciliation ----------------
+
+    @staticmethod
+    def _ref_ready(ref) -> bool:
+        """Non-blocking readiness check that works for results living on
+        OTHER nodes: wait() triggers the remote pull, where a bare local
+        store.status() would report 'missing' forever. A locally-EVICTED
+        result also counts as ready — the call ran; get() reconstructs
+        from lineage."""
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+        if ready:
+            return True
+        worker = ray_tpu.worker.global_worker()
+        return worker.store.status(ref.object_id) == "evicted"
 
     @staticmethod
     def _same_spec(a: dict, b: dict) -> bool:
@@ -226,12 +296,145 @@ class ServeController:
                 for app_name, app in self._apps.items()
                 for name, ds in app["deployments"].items()
             ]
+            proxy_cfg = self._proxy_cfg
         changed = False
         for app_name, name, ds in work:
             changed |= self._reconcile_deployment(app_name, name, ds)
+        if proxy_cfg is not None:
+            self._reconcile_proxies(proxy_cfg)
         if changed:
             with self._lock:
                 self._version += 1
+
+    # consecutive proxy-actor deaths before first HEALTHY that stop the
+    # respawn loop for that node (mirrors the replica crash-loop guard)
+    _MAX_PROXY_START_FAILURES = 3
+
+    def _reconcile_proxies(self, proxy_cfg: tuple) -> None:
+        """Desired state: one HEALTHY proxy actor per alive node. Dead or
+        ping-failing proxies are removed and recreated next pass; requests
+        through the other nodes' proxies keep flowing meanwhile. A node
+        whose proxy dies repeatedly before ever becoming healthy (bad
+        options, port conflict) flips to a sticky UNHEALTHY tombstone
+        visible in proxy_status() instead of respawning 5x/second forever."""
+        from ray_tpu.serve.proxy_actor import ProxyActor, proxy_actor_options
+
+        worker = ray_tpu.worker.global_worker()
+        try:
+            nodes = worker.gcs.call("get_nodes")["nodes"]
+        except Exception:  # noqa: BLE001 — GCS hiccup; retry next pass
+            return
+        alive = {n["node_id"] for n in nodes if n.get("alive")}
+        # a wildcard bind must be advertised as the node's REACHABLE ip
+        node_ip = {n["node_id"]: n.get("address", "").rsplit(":", 1)[0]
+                   for n in nodes}
+        now = time.monotonic()
+        with self._lock:
+            current = dict(self._proxies)
+        # reap proxies on dead nodes (and their failure tombstones)
+        for nid in list(current):
+            if nid not in alive:
+                ps = current.pop(nid)
+                with self._lock:
+                    self._proxies.pop(nid, None)
+                    self._proxy_failures.pop(nid, None)
+                if ps.handle is not None:
+                    try:
+                        ray_tpu.kill(ps.handle)
+                    except Exception:  # noqa: BLE001
+                        pass
+        # health-check and promote the rest
+        for nid, ps in current.items():
+            if ps.state == "UNHEALTHY":
+                continue  # sticky tombstone: operator must re-start_proxies
+            try:
+                info = worker.gcs.call(
+                    "get_actor", {"actor_id": ps.handle._actor_id.binary()}
+                )["actor"]
+            except Exception:  # noqa: BLE001
+                info = None
+            if (info or {}).get("state") == "DEAD":
+                self._proxy_died(nid, ps)
+                continue
+            if ps.ping_ref is not None:
+                if self._ref_ready(ps.ping_ref):
+                    try:
+                        # ping returns the bound addresses, so promotion
+                        # never needs a second, blocking RPC
+                        ps.addresses = ray_tpu.get(ps.ping_ref, timeout=5)
+                        host, port = ps.addresses.get("http", (None, None))
+                        if host in ("0.0.0.0", "::", ""):
+                            ps.addresses["http"] = (node_ip.get(nid, host),
+                                                    port)
+                        ps.state = "HEALTHY"
+                        with self._lock:
+                            self._proxy_failures.pop(nid, None)
+                        ps.next_ping_at = now + 1.0
+                    except Exception:  # noqa: BLE001 — failed check
+                        self._proxy_died(nid, ps, kill=True)
+                    ps.ping_ref = None
+                elif now > ps.ping_deadline:
+                    self._proxy_died(nid, ps, kill=True)
+            elif now >= ps.next_ping_at:
+                try:
+                    ps.ping_ref = ps.handle.ping.remote()
+                    ps.ping_deadline = now + 30.0
+                except Exception:  # noqa: BLE001 — dead; reaped above
+                    pass
+        # start proxies for uncovered nodes
+        http_options, grpc_options = proxy_cfg
+        with self._lock:
+            covered = set(self._proxies)
+        for nid in alive - covered:
+            try:
+                handle = ActorClass(
+                    ProxyActor,
+                    name=f"RT_SERVE_PROXY:{nid.hex()[:12]}",
+                    **proxy_actor_options(nid),
+                ).remote(http_options, grpc_options)
+            except Exception:  # noqa: BLE001 — e.g. stale name not yet
+                continue       # reaped by GCS; retry next pass
+            with self._lock:
+                if self._proxy_cfg is None:
+                    # shutdown raced us between the cfg snapshot and here
+                    # — don't leak the just-created actor (mirrors
+                    # _start_replica's ds.deleted guard)
+                    pass
+                else:
+                    self._proxies[nid] = _ProxyState(handle)
+                    continue
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _proxy_died(self, nid: bytes, ps: "_ProxyState",
+                    kill: bool = False) -> None:
+        """Remove a dead/failed proxy; repeated pre-healthy deaths leave a
+        sticky UNHEALTHY tombstone instead of a respawn loop."""
+        if kill and ps.handle is not None:
+            try:
+                ray_tpu.kill(ps.handle)
+            except Exception:  # noqa: BLE001
+                pass
+        with self._lock:
+            if ps.state == "STARTING":
+                n = self._proxy_failures.get(nid, 0) + 1
+                self._proxy_failures[nid] = n
+                if n >= self._MAX_PROXY_START_FAILURES:
+                    tomb = _ProxyState(None)
+                    tomb.state = "UNHEALTHY"
+                    self._proxies[nid] = tomb
+                    return
+            self._proxies.pop(nid, None)
+
+    def _remove_proxy(self, nid: bytes, ps: "_ProxyState") -> None:
+        with self._lock:
+            self._proxies.pop(nid, None)
+        try:
+            ray_tpu.kill(ps.handle)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _reconcile_deployment(self, app_name: str, name: str, ds: _DeploymentState) -> bool:
         changed = False
@@ -253,9 +456,7 @@ class ServeController:
                 if r.probe_ref is None:
                     r.probe_ref = r.handle.replica_metadata.remote()
                     r.probe_deadline = time.monotonic() + 120.0
-                elif worker.store.status(r.probe_ref.object_id) != "missing":
-                    # present OR evicted both mean the probe ran; get()
-                    # reconstructs an evicted result from lineage
+                elif self._ref_ready(r.probe_ref):
                     try:
                         meta = ray_tpu.get(r.probe_ref, timeout=30)
                         with self._lock:
@@ -335,13 +536,11 @@ class ServeController:
             return False
         now = time.monotonic()
         changed = False
-        worker = ray_tpu.worker.global_worker()
         for r in list(ds.replicas):
             if r.state != "RUNNING":
                 continue
             if r.ping_ref is not None:
-                done = worker.store.status(r.ping_ref.object_id) != "missing"
-                if done:
+                if self._ref_ready(r.ping_ref):
                     try:
                         ray_tpu.get(r.ping_ref, timeout=1)
                         r.ping_ref = None

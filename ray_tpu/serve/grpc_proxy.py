@@ -84,7 +84,8 @@ class GrpcProxy:
 
         app_name, method = self._target(context)
         ingress = self._ingress_for(app_name)
-        handle = DeploymentHandle(ingress, app_name)
+        handle = DeploymentHandle(ingress, app_name).options(
+            stream_chunk_timeout_s=self.options.request_timeout_s)
         payload = _decode(request)
         if method == "__call__":
             return handle.remote(payload)
@@ -100,9 +101,34 @@ class GrpcProxy:
         try:
             response = self._dispatch(request, context)
             if isinstance(response, DeploymentResponseGenerator):
-                # unary call on a streaming method: drain into a list
-                return _encode(list(response))
-            return _encode(response.result(timeout=120))
+                # unary call on a streaming method: drain into a list.
+                # Deliberate but surprising — tell the client (the Stream
+                # rpc is the intended entry; reference proxies reject this)
+                import logging
+
+                logging.getLogger("ray_tpu.serve").warning(
+                    "unary Call on a streaming deployment method — "
+                    "draining the full stream into one response; use the "
+                    "Stream rpc for incremental chunks")
+                context.set_trailing_metadata(
+                    (("ray-tpu-streaming-drained", "true"),))
+                # the drain respects the TOTAL request budget, not just
+                # per-chunk gaps — else a slow long generator pins one of
+                # the fixed worker threads indefinitely
+                budget = self.options.request_timeout_s
+                deadline = (time.monotonic() + budget
+                            if budget is not None else None)
+                chunks = []
+                for chunk in response:
+                    chunks.append(chunk)
+                    if deadline is not None and time.monotonic() > deadline:
+                        context.abort(
+                            grpc.StatusCode.DEADLINE_EXCEEDED,
+                            f"streaming drain exceeded request_timeout_s="
+                            f"{budget}; use the Stream rpc")
+                return _encode(chunks)
+            return _encode(
+                response.result(timeout=self.options.request_timeout_s))
         except KeyError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except Exception as e:  # noqa: BLE001 — surface to the client
@@ -126,7 +152,8 @@ class GrpcProxy:
                 for chunk in response:
                     yield _encode(chunk)
             else:
-                yield _encode(response.result(timeout=120))
+                yield _encode(
+                    response.result(timeout=self.options.request_timeout_s))
         except Exception as e:  # noqa: BLE001
             context.abort(grpc.StatusCode.INTERNAL, str(e))
 

@@ -21,6 +21,7 @@ from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponseGenerator
 class HTTPProxy:
     def __init__(self, options: HTTPOptions):
         self.options = options
+        self.port: int | None = None  # bound port (options.port=0 works)
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._runner = None
@@ -35,6 +36,13 @@ class HTTPProxy:
     def set_route(self, route_prefix: str, app_name: str, ingress: str) -> None:
         with self._routes_lock:
             self._routes[route_prefix.rstrip("/") or "/"] = (app_name, ingress)
+
+    def replace_routes(self, routes: dict[str, tuple[str, str]]) -> None:
+        """Swap in a full route table (proxy-actor route sync)."""
+        with self._routes_lock:
+            self._routes = {
+                (k.rstrip("/") or "/"): tuple(v) for k, v in routes.items()
+            }
 
     def remove_routes_for_app(self, app_name: str) -> None:
         with self._routes_lock:
@@ -103,18 +111,20 @@ class HTTPProxy:
             loop = asyncio.get_event_loop()
             queue: asyncio.Queue = asyncio.Queue(maxsize=16)
 
+            timeout_s = self.options.request_timeout_s
+
             def pump():
                 try:
                     for chunk in response_gen:
                         f = asyncio.run_coroutine_threadsafe(
                             queue.put(chunk), loop)
-                        f.result(timeout=120)
+                        f.result(timeout=timeout_s)
                     asyncio.run_coroutine_threadsafe(
-                        queue.put(_END), loop).result(timeout=120)
+                        queue.put(_END), loop).result(timeout=timeout_s)
                 except BaseException as e:  # noqa: BLE001 — ship to client
                     try:
                         asyncio.run_coroutine_threadsafe(
-                            queue.put(e), loop).result(timeout=120)
+                            queue.put(e), loop).result(timeout=timeout_s)
                     except Exception:
                         pass
 
@@ -153,11 +163,13 @@ class HTTPProxy:
             # ingresses the handle returns a response GENERATOR immediately
             # (dispatch is non-blocking); chunks are pumped by stream_response.
             def call_blocking():
-                handle = DeploymentHandle(ingress, app_name)
+                handle = DeploymentHandle(ingress, app_name).options(
+                    stream_chunk_timeout_s=self.options.request_timeout_s)
                 response = handle.remote(payload)
                 if isinstance(response, DeploymentResponseGenerator):
                     return response
-                return response.result(timeout=120)
+                return response.result(
+                    timeout=self.options.request_timeout_s)
 
             try:
                 result = await asyncio.get_event_loop().run_in_executor(
@@ -181,6 +193,7 @@ class HTTPProxy:
             loop.run_until_complete(runner.setup())
             site = web.TCPSite(runner, self.options.host, self.options.port)
             loop.run_until_complete(site.start())
+            self.port = site._server.sockets[0].getsockname()[1]
         except Exception as e:  # noqa: BLE001 — report to starter
             self._start_error = e
             self._started.set()

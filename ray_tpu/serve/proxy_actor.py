@@ -1,0 +1,106 @@
+"""Per-node ingress proxy actor, controller-managed.
+
+Equivalent of the reference's proxy actors (reference:
+python/ray/serve/_private/proxy_state.py:1 ProxyStateManager — the
+controller keeps one HTTP/gRPC proxy actor per node with health states
+and restarts them on failure; default_impl.py wires it up). The actor
+hosts the same HTTPProxy/GrpcProxy servers the dev-mode driver path
+uses, plus a route-sync thread that pulls the controller's versioned
+routing table (the same pull protocol handles use) so `serve.run`d route
+prefixes appear on every node without any push plumbing.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+_ROUTE_SYNC_PERIOD_S = 0.25
+
+
+class ProxyActor:
+    """Runs on one node; owns that node's ingress servers."""
+
+    def __init__(self, http_options: dict | None,
+                 grpc_options: dict | None):
+        from ray_tpu.serve.config import GrpcOptions, HTTPOptions
+
+        self._http = self._grpc = None
+        if http_options is not None:
+            from ray_tpu.serve.proxy import HTTPProxy
+
+            self._http = HTTPProxy(HTTPOptions(**http_options))
+            self._http.start()
+        if grpc_options is not None:
+            from ray_tpu.serve.grpc_proxy import GrpcProxy
+
+            self._grpc = GrpcProxy(GrpcOptions(**grpc_options))
+            self._grpc.start()
+        self._stopped = threading.Event()
+        self._route_version = None
+        if self._http is not None:
+            self._sync_thread = threading.Thread(
+                target=self._route_sync_loop, daemon=True,
+                name="serve-proxy-route-sync")
+            self._sync_thread.start()
+
+    # -- controller surface --
+
+    def ping(self) -> dict:
+        """Health probe; carries the bound addresses so the controller
+        never needs a second (potentially blocking) RPC to learn them."""
+        return self.addresses()
+
+    def addresses(self) -> dict:
+        """Bound (host, port) per protocol — ports may be ephemeral."""
+        out = {}
+        if self._http is not None:
+            out["http"] = (self._http.options.host, self._http.port)
+        if self._grpc is not None:
+            out["grpc"] = (self._grpc.options.host, self._grpc.port)
+        return out
+
+    def stop(self) -> str:
+        self._stopped.set()
+        if self._http is not None:
+            self._http.stop()
+        if self._grpc is not None:
+            self._grpc.stop()
+        return "stopped"
+
+    # -- route sync --
+
+    def _route_sync_loop(self) -> None:
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        controller = None
+        while not self._stopped.wait(_ROUTE_SYNC_PERIOD_S):
+            try:
+                if controller is None:
+                    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                table = ray_tpu.get(
+                    controller.get_routing_table.remote(), timeout=30)
+            except Exception:  # noqa: BLE001 — controller down/restarting
+                controller = None
+                continue
+            if table["version"] == self._route_version:
+                continue
+            self._route_version = table["version"]
+            self._http.replace_routes({
+                app["route_prefix"]: (app_name, app["ingress"])
+                for app_name, app in table["apps"].items()
+                if app.get("route_prefix")
+            })
+
+
+def proxy_actor_options(node_id: bytes) -> dict:
+    """ActorClass kwargs pinning one proxy to one node."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    return {
+        "num_cpus": 0.1,
+        "scheduling_strategy": NodeAffinitySchedulingStrategy(
+            node_id=node_id, soft=False),
+    }
