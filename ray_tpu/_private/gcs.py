@@ -16,6 +16,7 @@ else cancel (node_manager.cc:1832,1848 equivalents live in raylet.py).
 """
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import defaultdict
@@ -50,6 +51,12 @@ class GcsService:
         self._node_seq = 0
         self._node_tombstones: list[tuple[int, bytes]] = []
         self._tombstone_floor = 0  # removals below this seq were trimmed
+        # seq-ordered log of CHANGED nodes so a settled heartbeat's delta
+        # read is O(changes since seen), not an O(N) scan of the node
+        # table per tick — at N nodes x N heartbeats/s that scan was the
+        # control plane's fan-in ceiling
+        self._node_change_log: list[tuple[int, bytes]] = []
+        self._change_floor = 0  # changes below this seq were trimmed
         # pushed node_delta ordering: seq-ordered outbox (appended under
         # _lock) + a single-flusher lock so publishes can't reorder
         self._delta_outbox: list[dict] = []
@@ -281,6 +288,16 @@ class GcsService:
         deltas, not full snapshots)."""
         self._node_seq += 1
         info["_seq"] = self._node_seq
+        nid = info.get("node_id")
+        if nid is not None:
+            self._node_change_log.append((self._node_seq, nid))
+            cap = max(1000, 4 * len(self.nodes))
+            if len(self._node_change_log) > cap:
+                # trim the oldest half; readers older than the floor get a
+                # full resync (same protocol as tombstone trimming)
+                keep = cap // 2
+                self._change_floor = self._node_change_log[-keep][0]
+                del self._node_change_log[:-keep]
 
     def _node_view_locked(self, nid: bytes, n: dict) -> dict:
         view = {
@@ -301,6 +318,7 @@ class GcsService:
     def rpc_register_node(self, conn, msgid, p):
         with self._lock:
             self.nodes[p["node_id"]] = info = {
+                "node_id": p["node_id"],  # self-identifying for change log
                 "address": p["address"],
                 "resources": p["resources"],
                 "labels": p.get("labels", {}),
@@ -354,17 +372,35 @@ class GcsService:
             if "seen_seq" in p:
                 seen = p["seen_seq"]
                 reply["seq"] = self._node_seq
-                if seen < self._tombstone_floor:
-                    # removal history trimmed past this client: full resync
+                if seen < self._tombstone_floor or seen < self._change_floor:
+                    # history trimmed past this client: full resync
                     seen = 0
                     reply["full"] = True
-                reply["delta"] = [
-                    self._node_view_locked(nid, n)
-                    for nid, n in self.nodes.items()
-                    if n.get("_seq", 0) > seen and n["alive"]
-                ]
+                if reply.get("full"):
+                    reply["delta"] = [
+                        self._node_view_locked(nid, n)
+                        for nid, n in self.nodes.items()
+                        if n["alive"]
+                    ]
+                else:
+                    # O(changes) read off the seq-ordered change log — a
+                    # settled cluster's heartbeat must not scan N nodes
+                    i = bisect.bisect_left(self._node_change_log,
+                                           (seen + 1, b""))
+                    seen_nids = set()
+                    reply["delta"] = []
+                    for _s, nid in self._node_change_log[i:]:
+                        if nid in seen_nids:
+                            continue
+                        seen_nids.add(nid)
+                        n = self.nodes.get(nid)
+                        if n is not None and n["alive"] and \
+                                n.get("_seq", 0) > seen:
+                            reply["delta"].append(
+                                self._node_view_locked(nid, n))
+                j = bisect.bisect_left(self._node_tombstones, (seen + 1, b""))
                 reply["removed"] = [
-                    nid for seq, nid in self._node_tombstones if seq > seen
+                    nid for _seq, nid in self._node_tombstones[j:]
                 ]
         self._flush_node_deltas()
         return reply

@@ -2,11 +2,15 @@
 
 Equivalent in role to the reference's gRPC wrapper layer
 (reference: src/ray/rpc/grpc_server.h, client_call.h — async server/client
-call templates). The control plane here is deliberately small: length-prefixed
-msgpack arrays over TCP, thread-per-connection servers, plus server→client
-push notifications (used for task completion, pubsub delivery, and actor
-state changes — the analog of the reference's long-poll pubsub,
-src/ray/pubsub/publisher.h).
+call templates over an asio io_context). The control plane here is
+deliberately small: length-prefixed msgpack arrays over TCP, a
+selector-based event-loop server (one loop thread multiplexes every
+connection; handlers run on a small on-demand pool with per-connection
+FIFO ordering — the asio analog, NOT thread-per-connection, which kept
+one idle OS thread per open socket and capped node fan-in), plus
+server→client push notifications (used for task completion, pubsub
+delivery, and actor state changes — the analog of the reference's
+long-poll pubsub, src/ray/pubsub/publisher.h).
 
 Wire format: [u32 len][msgpack array]
   request:  [0, msgid, method: str, payload]
@@ -15,12 +19,14 @@ Wire format: [u32 len][msgpack array]
 """
 from __future__ import annotations
 
+import collections
+import selectors
 import socket
 import struct
 import threading
 import time
 import traceback
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable
 
 import msgpack
@@ -61,51 +67,77 @@ def _read_msg(sock: socket.socket) -> list | None:
 
 
 class Connection:
-    """Server-side handle to one client connection; safe concurrent sends."""
+    """Server-side handle to one client connection; safe concurrent sends.
 
-    def __init__(self, sock: socket.socket, peer: str):
+    The socket is nonblocking and owned by the server's event loop:
+    send() from ANY thread appends to the connection's outbox and wakes
+    the loop, which flushes when the socket is writable (asio-style
+    buffered writes — a slow reader can no longer block a pool thread
+    inside sendall)."""
+
+    def __init__(self, sock: socket.socket, peer: str, server: "RpcServer"):
         self.sock = sock
         self.peer = peer
-        self._send_lock = threading.Lock()
+        self._server = server
         self.closed = False
         # Services can attach identity here (e.g. worker id after register).
         self.meta: dict[str, Any] = {}
         self.on_close: list[Callable[[Connection], None]] = []
+        # event-loop state (guarded by the server's conn lock)
+        self._rbuf = bytearray()
+        self._outbox: collections.deque[bytes] = collections.deque()
+        self._out_off = 0  # partial-write offset into outbox[0]
+        self._out_bytes = 0  # slow-consumer accounting
+        self._handshaken = False
+        # per-connection FIFO handler dispatch
+        self._tasks: collections.deque[list] = collections.deque()
+        self._draining = False
+        self._paused = False  # READ interest dropped (task backlog)
 
     def send(self, msg: list) -> bool:
-        data = _pack(msg)
-        with self._send_lock:
-            try:
-                self.sock.sendall(data)
-                return True
-            except OSError:
-                return False
+        """False when the connection is known-dead (reader saw EOF/error).
+        Like the old blocking sendall, a send that races death may still
+        report True — definitive failure surfaces via on_close."""
+        if self.closed:
+            return False
+        return self._server._enqueue_send(self, _pack(msg))
 
     def notify(self, topic: str, payload: Any) -> bool:
         return self.send([NOTIFY, 0, topic, payload])
 
     def close(self) -> None:
         self.closed = True
-        try:
-            self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        self.sock.close()
+        self._server._request_close(self)
 
 
 class RpcServer:
-    """Thread-per-connection RPC server dispatching to handler methods.
+    """Selector-based RPC server dispatching to handler methods.
 
-    Handlers are methods named ``rpc_<method>`` on the service object, called
-    as ``handler(conn, msgid, payload)``; the return value is the response
-    payload.
-    A handler may instead return the DEFERRED sentinel and later complete the
-    call via ``conn.send([RESPONSE, msgid, True, payload])`` — used for
-    blocking calls (e.g. waiting on an actor to start) without tying up the
-    connection's request loop.
+    One event-loop thread multiplexes accept/read/write for every
+    connection (the reference's asio io_context shape,
+    src/ray/rpc/grpc_server.h); complete frames dispatch onto a small
+    on-demand thread pool with PER-CONNECTION FIFO ordering, so handler
+    semantics match the old thread-per-connection server (one in-flight
+    request per connection, cross-connection parallelism) without an OS
+    thread pinned per idle socket — the former node-fan-in ceiling.
+
+    Handlers are methods named ``rpc_<method>`` on the service object,
+    called as ``handler(conn, msgid, payload)``; the return value is the
+    response payload. A handler may instead return the DEFERRED sentinel
+    and later complete the call via
+    ``conn.send([RESPONSE, msgid, True, payload])`` — used for blocking
+    calls (e.g. waiting on an actor to start) without tying up a pool
+    thread.
     """
 
     DEFERRED = object()
+    _POOL_WORKERS = 16
+    # slow-consumer policy: a peer that stops reading while we keep
+    # sending gets dropped once its outbox crosses this (gRPC's
+    # resource-exhausted analog); a peer that pipelines requests faster
+    # than handlers drain has its READ interest paused (TCP backpressure)
+    _MAX_OUTBOX_BYTES = 64 * 1024 * 1024
+    _MAX_PENDING_TASKS = 10_000
 
     def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0):
         self.service = service
@@ -139,108 +171,338 @@ class RpcServer:
                         raise
                     time.sleep(0.1)
         self._srv.listen(512)
+        self._srv.setblocking(False)
         self.address = f"{host}:{self._srv.getsockname()[1]}"
         self._stopped = threading.Event()
         self.connections: set[Connection] = set()
         self._conn_lock = threading.Lock()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name=f"rpc-accept-{self.address}"
+        # pool threads spawn on demand up to the cap; an idle server holds
+        # only the loop thread. Services whose handlers legitimately BLOCK
+        # inline (e.g. the client server's rpc_client_wait) declare a
+        # larger cap via a `rpc_pool_workers` class attribute.
+        self._pool = ThreadPoolExecutor(
+            max_workers=getattr(service, "rpc_pool_workers",
+                                self._POOL_WORKERS),
+            thread_name_prefix=f"rpc-pool-{self.address}")
+        self._sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._pending_writes: set[Connection] = set()
+        self._pending_closes: set[Connection] = set()
+        self._pending_resumes: set[Connection] = set()
+        self._sel.register(self._srv, selectors.EVENT_READ, "accept")
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._loop_thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"rpc-loop-{self.address}"
         )
-        self._accept_thread.start()
+        self._loop_thread.start()
 
-    def _accept_loop(self) -> None:
+    # ---------------- event loop ----------------
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    def _enqueue_send(self, conn: Connection, data: bytes) -> bool:
+        with self._conn_lock:
+            if conn.closed or conn not in self.connections:
+                return False
+            conn._outbox.append(data)
+            conn._out_bytes += len(data)
+            if conn._out_bytes > self._MAX_OUTBOX_BYTES:
+                # peer stopped reading: cut it loose rather than buffer
+                # toward OOM
+                self._pending_closes.add(conn)
+            self._pending_writes.add(conn)
+        self._wake()
+        return True
+
+    def _request_close(self, conn: Connection) -> None:
+        with self._conn_lock:
+            self._pending_closes.add(conn)
+        self._wake()
+
+    def _loop(self) -> None:
         while not self._stopped.is_set():
             try:
+                events = self._sel.select(timeout=1.0)
+            except OSError:
+                break
+            for key, mask in events:
+                tag = key.data
+                if tag == "accept":
+                    self._do_accept()
+                elif tag == "wake":
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except OSError:
+                        pass
+                else:  # a Connection
+                    conn: Connection = tag
+                    if mask & selectors.EVENT_READ:
+                        self._do_read(conn)
+                    if mask & selectors.EVENT_WRITE:
+                        self._do_write(conn)
+            # apply cross-thread requests (sends/closes/resumes) after IO
+            with self._conn_lock:
+                writes = [c for c in self._pending_writes
+                          if c in self.connections]
+                self._pending_writes.clear()
+                closes = list(self._pending_closes)
+                self._pending_closes.clear()
+                resumes = [c for c in self._pending_resumes
+                           if c in self.connections]
+                self._pending_resumes.clear()
+            for conn in resumes:
+                want = selectors.EVENT_READ | (
+                    selectors.EVENT_WRITE if conn._outbox else 0)
+                try:
+                    self._sel.register(conn.sock, want, conn)
+                except (KeyError, ValueError, OSError):
+                    pass
+            for conn in writes:
+                self._do_write(conn)
+            for conn in closes:
+                self._drop_conn(conn)
+        # loop exit: tear everything down
+        with self._conn_lock:
+            conns = list(self.connections)
+        for conn in conns:
+            self._drop_conn(conn)
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+
+    def _do_accept(self) -> None:
+        while True:
+            try:
                 sock, addr = self._srv.accept()
+            except BlockingIOError:
+                return
             except OSError:
                 return
+            sock.setblocking(False)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn = Connection(sock, f"{addr[0]}:{addr[1]}")
+            conn = Connection(sock, f"{addr[0]}:{addr[1]}", self)
             with self._conn_lock:
                 self.connections.add(conn)
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True,
-                name=f"rpc-conn-{conn.peer}",
-            ).start()
+            try:
+                self._sel.register(sock, selectors.EVENT_READ, conn)
+            except (KeyError, ValueError, OSError):
+                self._drop_conn(conn)
 
-    def _serve_conn(self, conn: Connection) -> None:
-        handshaken = False
+    def _do_read(self, conn: Connection) -> None:
         try:
-            while not self._stopped.is_set():
-                msg = _read_msg(conn.sock)
-                if msg is None:
+            while True:
+                chunk = conn.sock.recv(1 << 16)
+                if not chunk:
+                    self._drop_conn(conn)
+                    return
+                conn._rbuf += chunk
+                if len(chunk) < (1 << 16):
                     break
-                mtype, msgid, method, payload = msg
-                if mtype != REQUEST:
-                    continue
-                if method == "_handshake":
-                    # version negotiation, answered by the RPC layer itself
-                    # (schema.py; the analog of proto compatibility checks)
-                    from ray_tpu._private import schema
+        except BlockingIOError:
+            pass
+        except OSError:
+            self._drop_conn(conn)
+            return
+        # extract complete frames
+        buf = conn._rbuf
+        frames = []
+        off = 0
+        while len(buf) - off >= 4:
+            (length,) = struct.unpack_from("<I", buf, off)
+            if len(buf) - off - 4 < length:
+                break
+            frames.append(bytes(buf[off + 4:off + 4 + length]))
+            off += 4 + length
+        if off:
+            del buf[:off]
+        if not frames:
+            return
+        with self._conn_lock:
+            for raw in frames:
+                conn._tasks.append(raw)
+            start = not conn._draining and bool(conn._tasks)
+            if start:
+                conn._draining = True
+            pause = (len(conn._tasks) > self._MAX_PENDING_TASKS
+                     and not conn._paused)
+            if pause:
+                conn._paused = True
+        if pause:
+            # stop reading this socket: the kernel buffer fills and TCP
+            # pushes back on the sender (the drainer resumes us)
+            try:
+                self._sel.unregister(conn.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+        if start:
+            self._pool.submit(self._drain_conn, conn)
 
-                    try:
-                        conn.send([RESPONSE, msgid, True,
-                                   schema.check_handshake(payload)])
-                        handshaken = True
-                    except schema.SchemaError as e:
-                        conn.send([RESPONSE, msgid, False, str(e)])
-                    continue
-                if self._strict and not handshaken:
-                    # the documented contract (docs/CROSS_LANGUAGE.md): the
-                    # FIRST call on a connection must be _handshake; in
-                    # strict mode enforce it server-side so incompatible
-                    # clients can't bypass version detection
-                    conn.send([RESPONSE, msgid, False,
-                               "protocol error: first request on a "
-                               "connection must be _handshake (strict mode)"])
-                    continue
-                handler = getattr(self.service, "rpc_" + method, None)
-                if handler is None:
-                    conn.send([RESPONSE, msgid, False, f"no such method: {method}"])
-                    continue
-                try:
-                    if self._schema_service is not None and self._strict:
-                        from ray_tpu._private import schema
+    def _do_write(self, conn: Connection) -> None:
+        try:
+            while conn._outbox:
+                data = conn._outbox[0]
+                n = conn.sock.send(
+                    memoryview(data)[conn._out_off:])
+                conn._out_off += n
+                conn._out_bytes -= n
+                if conn._out_off < len(data):
+                    break  # kernel buffer full
+                conn._outbox.popleft()
+                conn._out_off = 0
+        except BlockingIOError:
+            pass
+        except OSError:
+            self._drop_conn(conn)
+            return
+        # toggle WRITE interest to match backlog
+        want = selectors.EVENT_READ | (
+            selectors.EVENT_WRITE if conn._outbox else 0)
+        try:
+            self._sel.modify(conn.sock, want, conn)
+        except (KeyError, ValueError, OSError):
+            pass
 
-                        schema.validate_request(
-                            self._schema_service, method, payload)
-                    t0 = time.perf_counter()
-                    result = handler(conn, msgid, payload)
-                    event_stats.record(
-                        f"rpc.{self._stats_name}.{method}",
-                        time.perf_counter() - t0,
-                    )
-                    if result is not RpcServer.DEFERRED:
-                        conn.send([RESPONSE, msgid, True, result])
-                except Exception:
-                    conn.send([RESPONSE, msgid, False, traceback.format_exc()])
-        finally:
+    def _drop_conn(self, conn: Connection) -> None:
+        with self._conn_lock:
+            if conn not in self.connections:
+                return
+            self.connections.discard(conn)
+            self._pending_writes.discard(conn)
+            self._pending_closes.discard(conn)
+            self._pending_resumes.discard(conn)
+        conn.closed = True
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        if conn.on_close:
+            # death handlers can do real blocking work (the raylet's
+            # actor-death path makes GCS calls) — never run them on the
+            # event loop, which must keep serving every other connection
+            try:
+                self._pool.submit(self._run_on_close, conn)
+            except RuntimeError:  # pool already shut down (server stop)
+                self._run_on_close(conn)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def _run_on_close(conn: Connection) -> None:
+        for cb in conn.on_close:
+            try:
+                cb(conn)
+            except Exception:
+                pass
+
+    # ---------------- handler dispatch (pool threads) ----------------
+
+    def _drain_conn(self, conn: Connection) -> None:
+        """Process this connection's queued frames in order; exactly one
+        drainer per connection at a time (FIFO semantics)."""
+        while True:
             with self._conn_lock:
-                self.connections.discard(conn)
-            for cb in conn.on_close:
-                try:
-                    cb(conn)
-                except Exception:
-                    pass
-            conn.close()
+                if not conn._tasks or conn.closed:
+                    conn._draining = False
+                    return
+                raw = conn._tasks.popleft()
+                resume = (conn._paused
+                          and len(conn._tasks) < self._MAX_PENDING_TASKS // 2)
+                if resume:
+                    conn._paused = False
+                    self._pending_resumes.add(conn)
+            if resume:
+                self._wake()
+            try:
+                msg = msgpack.unpackb(raw, raw=False)
+                if not (isinstance(msg, list) and len(msg) == 4):
+                    raise ValueError(f"malformed frame: {msg!r}")
+                self._handle_msg(conn, msg)
+            except Exception:
+                # a malformed or handler-crashing frame must never wedge
+                # the drainer with _draining stuck True — drop the peer,
+                # like the old per-connection loop's finally did
+                with self._conn_lock:
+                    conn._draining = False
+                self._request_close(conn)
+                return
+
+    def _handle_msg(self, conn: Connection, msg: list) -> None:
+        mtype, msgid, method, payload = msg
+        if mtype != REQUEST:
+            return
+        if method == "_handshake":
+            # version negotiation, answered by the RPC layer itself
+            # (schema.py; the analog of proto compatibility checks)
+            from ray_tpu._private import schema
+
+            try:
+                conn.send([RESPONSE, msgid, True,
+                           schema.check_handshake(payload)])
+                conn._handshaken = True
+            except schema.SchemaError as e:
+                conn.send([RESPONSE, msgid, False, str(e)])
+            return
+        if self._strict and not conn._handshaken:
+            # the documented contract (docs/CROSS_LANGUAGE.md): the
+            # FIRST call on a connection must be _handshake; in
+            # strict mode enforce it server-side so incompatible
+            # clients can't bypass version detection
+            conn.send([RESPONSE, msgid, False,
+                       "protocol error: first request on a "
+                       "connection must be _handshake (strict mode)"])
+            return
+        handler = getattr(self.service, "rpc_" + method, None)
+        if handler is None:
+            conn.send([RESPONSE, msgid, False, f"no such method: {method}"])
+            return
+        try:
+            if self._schema_service is not None and self._strict:
+                from ray_tpu._private import schema
+
+                schema.validate_request(
+                    self._schema_service, method, payload)
+            t0 = time.perf_counter()
+            c0 = time.thread_time()
+            result = handler(conn, msgid, payload)
+            event_stats.record(
+                f"rpc.{self._stats_name}.{method}",
+                time.perf_counter() - t0,
+            )
+            # CPU seconds of the handler itself: the honest "handler work"
+            # measure when hundreds of in-process peers share one GIL and
+            # wall time mostly measures the scheduler
+            event_stats.record(
+                f"rpc.{self._stats_name}.{method}.cpu",
+                time.thread_time() - c0,
+            )
+            if result is not RpcServer.DEFERRED:
+                conn.send([RESPONSE, msgid, True, result])
+        except Exception:
+            conn.send([RESPONSE, msgid, False, traceback.format_exc()])
 
     def stop(self) -> None:
         self._stopped.set()
-        try:
-            # shutdown() first: a thread parked in accept() holds the fd
-            # alive through CPython's close(), leaving the port LISTENING
-            # forever; shutdown wakes it so close() actually releases the
-            # port (restart-in-place depends on this)
-            self._srv.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
+        self._wake()
         try:
             self._srv.close()
         except OSError:
             pass
-        with self._conn_lock:
-            for conn in list(self.connections):
-                conn.close()
+        self._loop_thread.join(timeout=5)
+        self._pool.shutdown(wait=False)
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class RpcClient:

@@ -206,15 +206,32 @@ class ClientService:
         return {"results": out}
 
     def rpc_client_wait(self, conn, msgid, p):
+        """DEFERRED: wait() parks for up to the client's timeout; it must
+        hold its own thread, not one of the RPC pool's — parked waits
+        would otherwise starve every other client's calls."""
+        import traceback as _tb
+
+        from ray_tpu._private.rpc import RESPONSE, RpcServer
+
         s = self._session(conn)
         refs = [ObjectRef(ObjectID(o)) for o in p["oids"]]
-        ready, not_ready = s.worker.wait(
-            refs, num_returns=p["num_returns"], timeout=p.get("timeout")
-        )
-        return {
-            "ready": [r.binary() for r in ready],
-            "not_ready": [r.binary() for r in not_ready],
-        }
+
+        def run():
+            try:
+                ready, not_ready = s.worker.wait(
+                    refs, num_returns=p["num_returns"],
+                    timeout=p.get("timeout"),
+                )
+                conn.send([RESPONSE, msgid, True, {
+                    "ready": [r.binary() for r in ready],
+                    "not_ready": [r.binary() for r in not_ready],
+                }])
+            except Exception:  # noqa: BLE001 — surface to the client
+                conn.send([RESPONSE, msgid, False, _tb.format_exc()])
+
+        threading.Thread(target=run, daemon=True,
+                         name="client-wait").start()
+        return RpcServer.DEFERRED
 
     def rpc_client_submit(self, conn, msgid, p):
         s = self._session(conn)
