@@ -29,6 +29,129 @@ def test_conda_and_container_fail_fast_without_binaries():
         validate_runtime_env({"not_a_plugin": 1})
 
 
+FAKE_CONDA = """#!/usr/bin/env python3
+import json, os, sys
+args = sys.argv[1:]
+with open(os.environ["FAKE_CONDA_LOG"], "a") as f:
+    f.write(" ".join(args) + "\\n")
+if args[:2] == ["env", "create"]:
+    prefix = args[args.index("-p") + 1]
+    os.makedirs(os.path.join(prefix, "bin"), exist_ok=True)
+    with open(os.path.join(prefix, "bin", "fake-env-marker"), "w") as f:
+        f.write("ok")
+elif args[:2] == ["env", "list"]:
+    # absolute prefixes, like real conda; FAKE_CONDA_PREFIX names one env
+    envs = [os.environ["FAKE_CONDA_PREFIX"]] \\
+        if os.environ.get("FAKE_CONDA_PREFIX") else []
+    print(json.dumps({"envs": envs}))
+"""
+
+
+def test_conda_lifecycle_under_fake_binary(tmp_path):
+    """PATH-shim `conda` (reference tests mock the same way): the FULL
+    plugin lifecycle runs — validate passes, create invokes the binary
+    once, a second use hits the content-addressed cache, apply prepends
+    the env's bin to the worker PATH, and delete GCs the env dir."""
+    shim = tmp_path / "bin"
+    shim.mkdir()
+    conda = shim / "conda"
+    conda.write_text(FAKE_CONDA)
+    conda.chmod(0o755)
+    log = tmp_path / "conda.log"
+    log.write_text("")
+    code = textwrap.dedent("""
+        import os
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=1)
+
+        @ray_tpu.remote
+        def probe():
+            first = os.environ["PATH"].split(os.pathsep)[0]
+            return first, os.path.exists(
+                os.path.join(first, "fake-env-marker"))
+
+        env = {"runtime_env": {"conda": {"dependencies": ["fakepkg"]}}}
+        bin1, marker1 = ray_tpu.get(probe.options(**env).remote(), timeout=120)
+        assert marker1, bin1  # create() materialized the env
+        assert bin1.endswith(os.path.join("env", "bin")), bin1
+        # second use: cache hit (the log assertion happens driver-side)
+        bin2, marker2 = ray_tpu.get(probe.options(**env).remote(), timeout=120)
+        assert (bin2, marker2) == (bin1, True)
+        # a plain task is untouched (restore ran) — num_cpus=1 pins every
+        # task to the SAME worker, so this can't pass by landing elsewhere
+        bin3, _ = ray_tpu.get(probe.remote(), timeout=120)
+        assert bin3 != bin1, bin3
+
+        # named-env path: apply() resolves the prefix via `conda env list`
+        named_bin, named_marker = ray_tpu.get(
+            probe.options(runtime_env={"conda": "fakenamed"}).remote(),
+            timeout=120)
+        assert named_bin == os.path.join(
+            os.environ["FAKE_CONDA_PREFIX"], "bin"), named_bin
+
+        # delete: GC the cached env through the plugin
+        from ray_tpu._private.runtime_env_plugin import (
+            _plugin_env_dir, get_plugin,
+        )
+        plugin = get_plugin("conda")
+        env_dir = _plugin_env_dir(plugin, env["runtime_env"]["conda"])
+        assert os.path.isdir(env_dir)
+        plugin.delete(env_dir)
+        assert not os.path.exists(env_dir)
+        print("CONDA_LIFECYCLE_OK")
+        ray_tpu.shutdown()
+    """)
+    named_prefix = tmp_path / "named" / "fakenamed"
+    (named_prefix / "bin").mkdir(parents=True)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PATH=f"{shim}:{os.environ['PATH']}",
+        FAKE_CONDA_LOG=str(log),
+        FAKE_CONDA_PREFIX=str(named_prefix),
+        RAY_TPU_RUNTIME_ENV_DIR=str(tmp_path / "envs"),
+    )
+    # outer timeout exceeds the worst-case SUM of inner get timeouts so a
+    # stalled get reports through its own diagnostic, not TimeoutExpired
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=560, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "CONDA_LIFECYCLE_OK" in r.stdout
+    # the fake binary ran `env create` exactly ONCE across both tasks
+    creates = [ln for ln in log.read_text().splitlines()
+               if ln.startswith("env create")]
+    assert len(creates) == 1, log.read_text()
+
+
+def test_container_validates_under_fake_docker(tmp_path):
+    """A PATH-shim docker flips container validation from fail-fast to
+    accepted (the binary gate is the only difference)."""
+    shim = tmp_path / "bin"
+    shim.mkdir()
+    docker = shim / "docker"
+    docker.write_text("#!/bin/sh\nexit 0\n")
+    docker.chmod(0o755)
+    code = textwrap.dedent("""
+        from ray_tpu._private.runtime_env import validate_runtime_env
+
+        validate_runtime_env({"container": {"image": "img:latest"}})
+        try:
+            validate_runtime_env({"container": {"tag": "x"}})
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("malformed container value accepted")
+        print("CONTAINER_VALIDATE_OK")
+    """)
+    env = dict(os.environ, PATH=f"{shim}:{os.environ['PATH']}",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "CONTAINER_VALIDATE_OK" in r.stdout
+
+
 def test_custom_plugin_applies_in_workers(tmp_path):
     """A third-party plugin registered via RAY_TPU_RUNTIME_ENV_PLUGINS:
     create() runs once per distinct value (content-addressed), apply()
