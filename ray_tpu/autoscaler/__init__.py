@@ -7,6 +7,7 @@ cloud providers implement the same 4-method contract).
 """
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
 from ray_tpu.autoscaler.v2 import AutoscalerV2, InstanceManager, Reconciler
+from ray_tpu.autoscaler.gcp import GCPNodeProvider, load_cluster_config
 from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider, NodeProvider
 from ray_tpu.autoscaler.resource_demand_scheduler import (
     NodeTypeConfig,
@@ -16,6 +17,8 @@ from ray_tpu.autoscaler.resource_demand_scheduler import (
 __all__ = [
     "AutoscalerV2",
     "FakeMultiNodeProvider",
+    "GCPNodeProvider",
+    "load_cluster_config",
     "InstanceManager",
     "Reconciler",
     "NodeProvider",

@@ -91,6 +91,11 @@ class StandardAutoscaler(GcsPollingLoop):
 
     def update(self) -> dict:
         nodes, demands, capacity = self._gcs_snapshot()
+        if hasattr(self.provider, "set_cluster_nodes"):
+            # cloud providers resolve internal_id from node labels: hand
+            # them the snapshot we already pulled instead of one RPC per
+            # managed node per tick
+            self.provider.set_cluster_nodes(list(nodes.values()))
         managed = self.provider.non_terminated_nodes()
         counts: dict[str, int] = {}
         for pid, t in managed.items():
