@@ -22,10 +22,14 @@ def train_loop(config):
 
     import jax
 
-    # workers are fresh processes: a JAX_PLATFORMS=cpu request must be
-    # re-asserted in-process (platform-forcing sitecustomize hooks may
-    # override the env var at interpreter start)
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # Workers are fresh processes and must match the DRIVER's platform
+    # decision, not the ambient env: a driver that runs on the CPU mesh
+    # passes force_cpu so workers never probe the accelerator (on a TPU
+    # host with a wedged tunnel, backend discovery can hang a worker
+    # forever — the env var alone doesn't capture an in-process
+    # jax.config.update("jax_platforms", "cpu") in the driver).
+    if config.get("force_cpu") or (
+            os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"):
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import optax
@@ -64,10 +68,25 @@ def train_loop(config):
 
 
 def main():
+    import sys
+
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    # propagate the driver's platform to the gang: if jax is already up on
+    # CPU here (tests force it; JAX_PLATFORMS=cpu runs force it), workers
+    # must not initialize an accelerator backend
+    force_cpu = False
+    if "jax" in sys.modules:
+        import jax
+
+        # only an EXPLICIT cpu-only platform config counts: the unset
+        # default (None) means "use the accelerator", and forcing workers
+        # to CPU then would silently de-accelerate real training
+        plat = jax.config.jax_platforms or ""
+        force_cpu = bool(plat) and set(plat.split(",")) == {"cpu"}
     trainer = JaxTrainer(
         train_loop,
-        train_loop_config={"tiny": TINY, "steps": 20 if TINY else 200},
+        train_loop_config={"tiny": TINY, "steps": 20 if TINY else 200,
+                           "force_cpu": force_cpu},
         scaling_config=ScalingConfig(num_workers=1),
         run_config=RunConfig(name="gpt-example"),
     )

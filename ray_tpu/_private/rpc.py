@@ -342,7 +342,11 @@ class RpcServer:
             except (KeyError, ValueError, OSError):
                 pass
         if start:
-            self._pool.submit(self._drain_conn, conn)
+            try:
+                self._pool.submit(self._drain_conn, conn)
+            except RuntimeError:  # pool shut down mid-teardown
+                with self._conn_lock:
+                    conn._draining = False
 
     def _do_write(self, conn: Connection) -> None:
         try:

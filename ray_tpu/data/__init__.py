@@ -25,6 +25,7 @@ from ray_tpu.data.datasource import (
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
 )
@@ -51,6 +52,7 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
     "read_tfrecords",
 ]

@@ -663,6 +663,26 @@ class Dataset:
 
         return self._write(path, w, "tfrecords")
 
+    def write_sql(self, sql: str, connection_factory) -> int:
+        """INSERT every row through a DBAPI-2 statement with positional
+        placeholders, one executemany per block (reference: dataset.py
+        write_sql / SQLDatasink). Returns the row count written."""
+        self.materialize()
+        total = 0
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            for ref, meta in self._cached:
+                t = ray_tpu.get(ref, timeout=600)
+                rows = [tuple(r.values()) for r in t.to_pylist()]
+                if rows:
+                    cur.executemany(sql, rows)
+                    total += len(rows)
+            conn.commit()
+        finally:
+            conn.close()
+        return total
+
     # -- misc ---------------------------------------------------------------
 
     def stats(self) -> str:

@@ -267,6 +267,29 @@ def read_huggingface(path: str) -> Dataset:
     return Dataset([_Read(files, read)])
 
 
+def read_sql(sql: str, connection_factory) -> Dataset:
+    """Rows from a DBAPI-2 query as ONE read task (reference:
+    read_api.py read_sql / sql_datasource.py — which likewise executes an
+    un-shardable query serially; shard by issuing multiple read_sql calls
+    with WHERE-partitioned queries and `Dataset.union`). The zero-arg
+    ``connection_factory`` runs inside the task, so it works with
+    sqlite3, psycopg2, mysql-connector, ..."""
+
+    def read(_src) -> pa.Table:
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        return pa.Table.from_pylist(
+            [dict(zip(cols, r)) for r in rows]) if rows else pa.table({})
+
+    return Dataset([_Read([sql], read)])
+
+
 def read_binary_files(paths, *, include_paths: bool = False,
                       parallelism: int = -1) -> Dataset:
     """One row per file with its raw bytes (reference:

@@ -38,3 +38,11 @@ __all__ += ["Bandit", "BanditConfig", "BanditLinTSConfig",
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
 
 __all__ += ["R2D2", "R2D2Config"]
+
+from ray_tpu.rllib.algorithms.alphazero import (
+    AlphaZero,
+    AlphaZeroConfig,
+    TicTacToe,
+)
+
+__all__ += ["AlphaZero", "AlphaZeroConfig", "TicTacToe"]

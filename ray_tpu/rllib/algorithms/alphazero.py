@@ -1,0 +1,295 @@
+"""AlphaZero — self-play MCTS with a policy/value network.
+
+Equivalent of the reference's AlphaZero (reference:
+rllib_contrib/alpha_zero/src/rllib_alpha_zero/ — PUCT tree search guided
+by a policy/value net, self-play targets = visit distributions + game
+outcome; Silver et al. 2018). TPU-first split, same as the rest of
+rllib here: the tree search runs in numpy on the host (it is pointer
+chasing, not linear algebra), while training is one jitted update over
+(board, visit-dist, outcome) minibatches.
+
+Games implement the two-player zero-sum canonical-form protocol below
+(board always from the player-to-move's perspective); TicTacToe ships
+in-tree as the smoke-test game.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.rl_module import ActorCriticModule, _init_linear
+
+
+class TicTacToe:
+    """Canonical-form tic-tac-toe: board [9] with +1 = player to move,
+    -1 = opponent. `step` returns the NEXT canonical board (flipped)."""
+
+    num_actions = 9
+    obs_dim = 9
+
+    def initial(self) -> np.ndarray:
+        return np.zeros(9, np.float32)
+
+    def legal_actions(self, board: np.ndarray) -> np.ndarray:
+        return np.flatnonzero(board == 0)
+
+    def step(self, board: np.ndarray, action: int) -> np.ndarray:
+        nxt = board.copy()
+        nxt[action] = 1.0
+        return -nxt  # perspective flip: the other player moves next
+
+    _LINES = [(0, 1, 2), (3, 4, 5), (6, 7, 8), (0, 3, 6), (1, 4, 7),
+              (2, 5, 8), (0, 4, 8), (2, 4, 6)]
+
+    def terminal(self, board: np.ndarray) -> tuple[bool, float]:
+        """(done, outcome for the player to move). The PREVIOUS mover's
+        stones are -1 after the flip, so a completed line of -1 means the
+        player to move has LOST."""
+        for a, b, c in self._LINES:
+            if board[a] == board[b] == board[c] == -1.0:
+                return True, -1.0
+        if not (board == 0).any():
+            return True, 0.0
+        return False, 0.0
+
+
+class AlphaZeroModule(ActorCriticModule):
+    """Policy/value net over the canonical board: shared tanh trunk, a
+    masked-softmax policy head and a tanh value head in [-1, 1]."""
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        dims = [self.obs_dim, *self.hidden]
+        trunk = [_init_linear(rng, dims[i], dims[i + 1], np.sqrt(2))
+                 for i in range(len(dims) - 1)]
+        return {
+            "trunk": trunk,
+            "pi": [_init_linear(rng, dims[-1], self.num_actions, 0.01)],
+            "vf": [_init_linear(rng, dims[-1], 1, 1.0)],
+        }
+
+    def forward_np(self, params, obs: np.ndarray):
+        h = obs
+        for layer in params["trunk"]:
+            h = np.tanh(h @ layer["w"] + layer["b"])
+        pi, vf = params["pi"][0], params["vf"][0]
+        logits = h @ pi["w"] + pi["b"]
+        value = np.tanh((h @ vf["w"] + vf["b"])[:, 0])
+        return logits, value
+
+    def forward(self, params, obs):
+        import jax.numpy as jnp
+
+        h = obs
+        for layer in params["trunk"]:
+            h = jnp.tanh(h @ layer["w"] + layer["b"])
+        pi, vf = params["pi"][0], params["vf"][0]
+        logits = h @ pi["w"] + pi["b"]
+        value = jnp.tanh((h @ vf["w"] + vf["b"])[:, 0])
+        return logits, value
+
+
+def alphazero_loss(module, params, batch, config):
+    """CE to the MCTS visit distribution + MSE to the game outcome
+    (Silver et al. 2018 eq. 1; L2 comes from the optimizer's weight
+    decay upstream — here adam + max_grad_norm)."""
+    import jax.numpy as jnp
+
+    logits, value = module.forward(params, batch["obs"])
+    logp = jnp.where(batch["legal"], logits, -1e9)
+    logp = logp - jnp.max(logp, axis=-1, keepdims=True)
+    logp = logp - jnp.log(
+        jnp.sum(jnp.exp(logp), axis=-1, keepdims=True))
+    policy_loss = -jnp.mean(jnp.sum(batch["pi"] * logp, axis=-1))
+    value_loss = jnp.mean((value - batch["z"]) ** 2)
+    loss = policy_loss + value_loss
+    return loss, {"policy_loss": policy_loss, "value_loss": value_loss}
+
+
+class _MCTS:
+    """PUCT search over canonical states (Silver et al. 2018 fig. 2)."""
+
+    def __init__(self, game, module, params, c_puct: float = 1.5,
+                 dirichlet_alpha: float = 0.6, noise_frac: float = 0.25,
+                 rng: np.random.Generator | None = None):
+        self.game = game
+        self.module = module
+        self.params = params
+        self.c_puct = c_puct
+        self.dirichlet_alpha = dirichlet_alpha
+        self.noise_frac = noise_frac
+        self.rng = rng or np.random.default_rng(0)
+        # state key -> {P, N, W, legal}
+        self.nodes: dict[bytes, dict] = {}
+
+    def _expand(self, board: np.ndarray) -> float:
+        """Create a leaf node from the net; returns its value estimate
+        (player-to-move perspective)."""
+        logits, value = self.module.forward_np(self.params, board[None, :])
+        legal = self.game.legal_actions(board)
+        mask = np.zeros(len(logits[0]), bool)
+        mask[legal] = True
+        z = logits[0] - logits[0].max()
+        p = np.exp(z) * mask
+        p = p / max(p.sum(), 1e-9)
+        self.nodes[board.tobytes()] = {
+            "P": p,
+            "N": np.zeros(len(p), np.float64),
+            "W": np.zeros(len(p), np.float64),
+            "legal": mask,
+        }
+        return float(value[0])
+
+    def _simulate(self, board: np.ndarray) -> float:
+        """One descent; returns the subtree value for the player to move
+        at `board`."""
+        done, outcome = self.game.terminal(board)
+        if done:
+            return outcome
+        key = board.tobytes()
+        node = self.nodes.get(key)
+        if node is None:
+            return self._expand(board)
+        n_total = node["N"].sum()
+        q = np.where(node["N"] > 0, node["W"] / np.maximum(node["N"], 1), 0.0)
+        u = (self.c_puct * node["P"] * np.sqrt(n_total + 1e-8)
+             / (1.0 + node["N"]))
+        score = np.where(node["legal"], q + u, -np.inf)
+        action = int(np.argmax(score))
+        # opponent's value negates on the way back up (zero-sum)
+        value = -self._simulate(self.game.step(board, action))
+        node["N"][action] += 1
+        node["W"][action] += value
+        return value
+
+    def search(self, board: np.ndarray, n_sims: int,
+               root_noise: bool = True) -> np.ndarray:
+        """Visit distribution over actions after n_sims descents."""
+        if board.tobytes() not in self.nodes:
+            self._expand(board)
+        root = self.nodes[board.tobytes()]
+        if root_noise:
+            legal = np.flatnonzero(root["legal"])
+            noise = self.rng.dirichlet(
+                [self.dirichlet_alpha] * len(legal))
+            p = root["P"].copy()
+            p[legal] = ((1 - self.noise_frac) * p[legal]
+                        + self.noise_frac * noise)
+            root["P"] = p
+        for _ in range(n_sims):
+            self._simulate(board)
+        pi = root["N"] / max(root["N"].sum(), 1e-9)
+        return pi
+
+
+class AlphaZeroConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.game = TicTacToe
+        self.n_simulations = 48
+        self.games_per_iteration = 24
+        self.temperature_moves = 4  # sample proportionally early, then argmax
+        self.buffer_capacity = 20_000
+        self.updates_per_iteration = 24
+        self.lr = 3e-3
+        self.hidden = (64, 64)
+        self.algo_class = AlphaZero
+
+
+class AlphaZero(Algorithm):
+    """Driver-side self-play + jitted policy/value updates."""
+
+    def _setup(self) -> None:
+        cfg = self.config
+        self.game = cfg.game() if isinstance(cfg.game, type) else cfg.game
+        self.module = AlphaZeroModule(
+            self.game.obs_dim, self.game.num_actions, tuple(cfg.hidden))
+        self.learner = Learner(
+            self.module, alphazero_loss, config={},
+            learning_rate=cfg.lr, max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh, seed=cfg.seed,
+        )
+        self._rng = np.random.default_rng(cfg.seed)
+        self._buf: list[tuple] = []
+        self._buf_head = 0
+
+    def _build_learner(self) -> None:  # pragma: no cover — done in _setup
+        pass
+
+    def _store(self, row: tuple) -> None:
+        if len(self._buf) < self.config.buffer_capacity:
+            self._buf.append(row)
+        else:
+            self._buf[self._buf_head] = row
+            self._buf_head = (self._buf_head + 1) % self.config.buffer_capacity
+
+    def _self_play_game(self, params) -> float:
+        cfg = self.config
+        mcts = _MCTS(self.game, self.module, params, rng=self._rng)
+        board = self.game.initial()
+        history: list[tuple] = []  # (board, pi, legal)
+        move = 0
+        while True:
+            done, outcome = self.game.terminal(board)
+            if done:
+                break
+            pi = mcts.search(board, cfg.n_simulations)
+            legal_mask = np.zeros(self.game.num_actions, bool)
+            legal_mask[self.game.legal_actions(board)] = True
+            history.append((board.copy(), pi.copy(), legal_mask))
+            if move < cfg.temperature_moves:
+                action = int(self._rng.choice(len(pi), p=pi))
+            else:
+                action = int(np.argmax(pi))
+            board = self.game.step(board, action)
+            move += 1
+        # outcome is from the FINAL player-to-move's perspective; walk
+        # back alternating signs
+        z = outcome
+        for board_t, pi_t, legal_t in reversed(history):
+            z = -z
+            self._store((board_t, pi_t, float(z), legal_t))
+        return outcome
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        params = self.learner.get_weights_np()
+        outcomes = [self._self_play_game(params)
+                    for _ in range(cfg.games_per_iteration)]
+        metrics_acc: dict[str, list[float]] = {}
+        if len(self._buf) >= cfg.minibatch_size:
+            for _ in range(cfg.updates_per_iteration):
+                idx = self._rng.integers(0, len(self._buf),
+                                         cfg.minibatch_size)
+                rows = [self._buf[i] for i in idx]
+                batch = {
+                    "obs": np.stack([r[0] for r in rows]),
+                    "pi": np.stack([r[1] for r in rows]).astype(np.float32),
+                    "z": np.asarray([r[2] for r in rows], np.float32),
+                    "legal": np.stack([r[3] for r in rows]),
+                }
+                for k, v in self.learner.update(batch).items():
+                    metrics_acc.setdefault(k, []).append(v)
+        out = {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+        # draws are the optimal self-play fixed point for tic-tac-toe
+        out["draw_rate"] = float(np.mean([o == 0.0 for o in outcomes]))
+        out["replay_size"] = len(self._buf)
+        return out
+
+    def compute_action(self, board: np.ndarray, n_simulations: int | None = None) -> int:
+        """Strongest move (no root noise, argmax visits)."""
+        mcts = _MCTS(self.game, self.module, self.learner.get_weights_np(),
+                     noise_frac=0.0, rng=self._rng)
+        pi = mcts.search(board, n_simulations or self.config.n_simulations,
+                         root_noise=False)
+        return int(np.argmax(pi))
+
+    def train(self) -> dict:
+        metrics = self.training_step()
+        self.iteration += 1
+        metrics["training_iteration"] = self.iteration
+        return metrics
+
+    def stop(self) -> None:
+        pass

@@ -11,6 +11,7 @@ from ray_tpu.rllib.offline.io import (
     compute_returns,
 )
 from ray_tpu.rllib.offline.cql import CQL, CQLConfig
+from ray_tpu.rllib.offline.dt import DT, DTConfig
 from ray_tpu.rllib.offline.marwil import BC, BCConfig, MARWIL, MARWILConfig
 
 __all__ = [
@@ -18,6 +19,8 @@ __all__ = [
     "BCConfig",
     "CQL",
     "CQLConfig",
+    "DT",
+    "DTConfig",
     "DatasetReader",
     "JsonReader",
     "JsonWriter",

@@ -1,0 +1,291 @@
+"""Decision Transformer — return-conditioned sequence modeling for
+offline RL.
+
+Equivalent of the reference's DT (reference: rllib/algorithms/dt/dt.py —
+a causal transformer over (return-to-go, state, action) token triples
+predicts the action at each state token; Chen et al. 2021). TPU-first:
+the model IS the hot path here, so unlike the MLP algorithms there is no
+numpy twin — training and evaluation both run the jitted forward with a
+FIXED context length (left-padded + masked), so XLA compiles exactly one
+shape for each.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.offline.io import DatasetReader, JsonReader
+
+
+def _linear(rng, n_in, n_out, scale=0.02):
+    return {
+        "w": (rng.standard_normal((n_in, n_out)) * scale).astype(np.float32),
+        "b": np.zeros(n_out, np.float32),
+    }
+
+
+class DTModule:
+    """Causal transformer over interleaved (R̂, s, a) tokens."""
+
+    def __init__(self, obs_dim: int, num_actions: int, context_len: int = 20,
+                 d_model: int = 64, n_layer: int = 2, n_head: int = 2,
+                 max_timestep: int = 1024):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.K = context_len
+        self.d_model = d_model
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.max_timestep = max_timestep
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        d = self.d_model
+        params = {
+            "emb_rtg": _linear(rng, 1, d),
+            "emb_obs": _linear(rng, self.obs_dim, d),
+            "emb_act": _linear(rng, self.num_actions, d),
+            # one positional row per TIMESTEP (shared by its 3 tokens) +
+            # a learned modality offset per token kind
+            "pos": (rng.standard_normal((self.max_timestep, d)) * 0.02
+                    ).astype(np.float32),
+            "modality": (rng.standard_normal((3, d)) * 0.02
+                         ).astype(np.float32),
+            "blocks": [],
+            "ln_f": {"g": np.ones(d, np.float32),
+                     "b": np.zeros(d, np.float32)},
+            "head": _linear(rng, d, self.num_actions),
+        }
+        for _ in range(self.n_layer):
+            params["blocks"].append({
+                "ln1": {"g": np.ones(d, np.float32),
+                        "b": np.zeros(d, np.float32)},
+                "qkv": _linear(rng, d, 3 * d),
+                "proj": _linear(rng, d, d),
+                "ln2": {"g": np.ones(d, np.float32),
+                        "b": np.zeros(d, np.float32)},
+                "fc1": _linear(rng, d, 4 * d),
+                "fc2": _linear(rng, 4 * d, d),
+            })
+        return params
+
+    # -- jax forward (training AND eval) --
+
+    def forward(self, params, rtg, obs, actions, timesteps):
+        """rtg [B,K], obs [B,K,D], actions [B,K] (int; position t's token
+        embeds a_t), timesteps [B,K] -> action logits at each STATE token
+        [B,K,A]."""
+        import jax
+        import jax.numpy as jnp
+
+        B, K = rtg.shape
+        d = self.d_model
+
+        def ln(p, x):
+            mu = jnp.mean(x, -1, keepdims=True)
+            var = jnp.var(x, -1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-5) * p["g"] + p["b"]
+
+        pos = params["pos"][timesteps]                      # [B,K,d]
+        tok_r = (rtg[..., None] @ params["emb_rtg"]["w"]
+                 + params["emb_rtg"]["b"]) + pos + params["modality"][0]
+        tok_s = (obs @ params["emb_obs"]["w"]
+                 + params["emb_obs"]["b"]) + pos + params["modality"][1]
+        a_onehot = jax.nn.one_hot(actions, self.num_actions,
+                                  dtype=jnp.float32)
+        tok_a = (a_onehot @ params["emb_act"]["w"]
+                 + params["emb_act"]["b"]) + pos + params["modality"][2]
+        # interleave -> [B, 3K, d] in (r_t, s_t, a_t) order
+        x = jnp.stack([tok_r, tok_s, tok_a], axis=2).reshape(B, 3 * K, d)
+        T = 3 * K
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        for blk in params["blocks"]:
+            h = ln(blk["ln1"], x)
+            qkv = h @ blk["qkv"]["w"] + blk["qkv"]["b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            hd = d // self.n_head
+
+            def heads(t):
+                return t.reshape(B, T, self.n_head, hd).transpose(0, 2, 1, 3)
+
+            q, k, v = heads(q), heads(k), heads(v)
+            att = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(hd)
+            att = jnp.where(causal, att, -1e9)
+            att = jax.nn.softmax(att, axis=-1)
+            out = (att @ v).transpose(0, 2, 1, 3).reshape(B, T, d)
+            x = x + (out @ blk["proj"]["w"] + blk["proj"]["b"])
+            h = ln(blk["ln2"], x)
+            h = jax.nn.gelu(h @ blk["fc1"]["w"] + blk["fc1"]["b"])
+            x = x + (h @ blk["fc2"]["w"] + blk["fc2"]["b"])
+        x = ln(params["ln_f"], x)
+        state_tokens = x.reshape(B, K, 3, d)[:, :, 1, :]
+        return state_tokens @ params["head"]["w"] + params["head"]["b"]
+
+
+def dt_loss(module, params, batch, config):
+    """CE between the state-token predictions and the logged actions,
+    masked to valid (non-padding) positions."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = module.forward(params, batch["rtg"], batch["obs"],
+                            batch["actions"], batch["timesteps"])
+    logp = jax.nn.log_softmax(logits)
+    picked = jnp.take_along_axis(
+        logp, batch["actions"][..., None], axis=-1)[..., 0]
+    mask = batch["mask"].astype(jnp.float32)
+    loss = -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return loss, {"action_ce": loss}
+
+
+class DTConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.input_ = None  # path / JsonReader / DatasetReader / Dataset
+        self.context_len = 20
+        self.d_model = 64
+        self.n_layer = 2
+        self.n_head = 2
+        self.updates_per_iteration = 64
+        self.minibatch_size = 64
+        self.lr = 1e-3
+        self.num_actions = None   # inferred from data when None
+        self.observation_dim = None
+        self.algo_class = DT
+
+    def offline_data(self, input_=None) -> "DTConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+
+class DT(Algorithm):
+    """Offline training over (R̂, s, a) windows + return-conditioned
+    evaluation."""
+
+    def _setup(self) -> None:
+        cfg = self.config
+        reader = cfg.input_
+        if isinstance(reader, str):
+            reader = JsonReader(reader)
+        elif reader is not None and not hasattr(reader, "episodes"):
+            reader = DatasetReader(reader)
+        if reader is None:
+            raise ValueError("DT requires config.offline_data(input_=...)")
+        self._episodes = []
+        max_len = 1
+        for ep in reader.episodes():
+            obs = np.asarray([r["obs"] for r in ep], np.float32)
+            acts = np.asarray([r["action"] for r in ep], np.int32)
+            rews = np.asarray([r["reward"] for r in ep], np.float32)
+            rtg = np.cumsum(rews[::-1])[::-1].copy()  # undiscounted, DT-style
+            self._episodes.append((obs, acts, rtg))
+            max_len = max(max_len, len(ep))
+        if not self._episodes:
+            raise ValueError("offline input is empty")
+        self.obs_dim = (cfg.observation_dim
+                        or int(self._episodes[0][0].shape[1]))
+        self.num_actions = (cfg.num_actions
+                            or int(max(a.max() for _, a, _ in
+                                       self._episodes)) + 1)
+        self.module = DTModule(
+            self.obs_dim, self.num_actions, cfg.context_len,
+            cfg.d_model, cfg.n_layer, cfg.n_head,
+            max_timestep=max(1024, max_len + cfg.context_len))
+        self.learner = Learner(
+            self.module, dt_loss, config={},
+            learning_rate=cfg.lr, max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh, seed=cfg.seed,
+        )
+        self._rng = np.random.default_rng(cfg.seed)
+
+    def _build_learner(self) -> None:  # pragma: no cover — done in _setup
+        pass
+
+    def _sample_window(self):
+        K = self.config.context_len
+        obs, acts, rtg = self._episodes[
+            self._rng.integers(len(self._episodes))]
+        T = len(acts)
+        start = int(self._rng.integers(0, max(1, T)))
+        end = min(start + K, T)
+        n = end - start
+        w_obs = np.zeros((K, self.obs_dim), np.float32)
+        w_act = np.zeros(K, np.int32)
+        w_rtg = np.zeros(K, np.float32)
+        w_ts = np.zeros(K, np.int64)
+        w_mask = np.zeros(K, bool)
+        w_obs[K - n:] = obs[start:end]
+        w_act[K - n:] = acts[start:end]
+        w_rtg[K - n:] = rtg[start:end]
+        w_ts[K - n:] = np.arange(start, end)
+        w_mask[K - n:] = True
+        return w_obs, w_act, w_rtg, w_ts, w_mask
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        metrics_acc: dict[str, list[float]] = {}
+        for _ in range(cfg.updates_per_iteration):
+            rows = [self._sample_window() for _ in range(cfg.minibatch_size)]
+            batch = {
+                "obs": np.stack([r[0] for r in rows]),
+                "actions": np.stack([r[1] for r in rows]),
+                "rtg": np.stack([r[2] for r in rows]),
+                "timesteps": np.stack([r[3] for r in rows]),
+                "mask": np.stack([r[4] for r in rows]),
+            }
+            for k, v in self.learner.update(batch).items():
+                metrics_acc.setdefault(k, []).append(v)
+        return {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+
+    def evaluate(self, env_spec, target_return: float,
+                 episodes: int = 5) -> float:
+        """Roll the env conditioning on `target_return` (Chen et al. 2021
+        eval protocol: decrement the return-to-go by observed rewards)."""
+        import jax
+
+        from ray_tpu.rllib.env import make_env
+
+        K = self.config.context_len
+        fwd = jax.jit(lambda p, r, o, a, t: self.module.forward(p, r, o, a, t))
+        params = self.learner.params
+        totals = []
+        for ep_i in range(episodes):
+            env = make_env(env_spec)
+            obs = env.reset(seed=1000 + ep_i)
+            hist_obs, hist_act, hist_rtg = [], [], []
+            rtg, total, done, t = target_return, 0.0, False, 0
+            while not done and t < getattr(env, "max_episode_steps", 1000):
+                hist_obs.append(np.asarray(obs, np.float32))
+                hist_rtg.append(rtg)
+                hist_act.append(0)  # placeholder for the current step
+                w_obs = np.zeros((1, K, self.obs_dim), np.float32)
+                w_act = np.zeros((1, K), np.int32)
+                w_rtg = np.zeros((1, K), np.float32)
+                w_ts = np.zeros((1, K), np.int64)
+                n = min(K, len(hist_obs))
+                w_obs[0, K - n:] = np.stack(hist_obs[-n:])
+                w_act[0, K - n:] = hist_act[-n:]
+                w_rtg[0, K - n:] = hist_rtg[-n:]
+                w_ts[0, K - n:] = np.arange(
+                    len(hist_obs) - n, len(hist_obs))
+                logits = np.asarray(fwd(params, w_rtg, w_obs, w_act, w_ts))
+                action = int(np.argmax(logits[0, -1]))
+                hist_act[-1] = action
+                obs, reward, term, trunc = env.step(action)
+                done = term or trunc
+                total += reward
+                rtg -= reward
+                t += 1
+            totals.append(total)
+        return float(np.mean(totals))
+
+    def train(self) -> dict:
+        metrics = self.training_step()
+        self.iteration += 1
+        metrics["training_iteration"] = self.iteration
+        return metrics
+
+    def stop(self) -> None:
+        pass

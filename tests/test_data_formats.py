@@ -149,6 +149,34 @@ def test_read_huggingface_dir_without_datasets_pkg(ray_start, tmp_path,
     assert len(rows) == 25
 
 
+def test_sql_read_write_roundtrip(ray_start, tmp_path):
+    """DBAPI-2 datasource against stdlib sqlite3 (reference:
+    read_api.py read_sql / dataset write_sql — same connection_factory
+    contract for any driver)."""
+    import sqlite3
+
+    from ray_tpu import data
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE scores (name TEXT, score REAL)")
+    conn.commit()
+    conn.close()
+    factory = lambda: sqlite3.connect(db)  # noqa: E731
+
+    ds = data.from_items([
+        {"name": f"p{i}", "score": float(i) * 1.5} for i in range(30)
+    ])
+    written = ds.write_sql("INSERT INTO scores VALUES (?, ?)", factory)
+    assert written == 30
+
+    back = data.read_sql(
+        "SELECT name, score FROM scores WHERE score >= 15 ORDER BY score",
+        factory).take_all()
+    assert [r["name"] for r in back] == [f"p{i}" for i in range(10, 30)]
+    assert back[0]["score"] == pytest.approx(15.0)
+
+
 def test_from_huggingface_object(ray_start):
     """from_huggingface over anything exposing the datasets arrow
     surface (import-gated: uses the real package when present, otherwise
