@@ -46,3 +46,7 @@ from ray_tpu.rllib.algorithms.alphazero import (
 )
 
 __all__ += ["AlphaZero", "AlphaZeroConfig", "TicTacToe"]
+
+from ray_tpu.rllib.algorithms.dreamer import Dreamer, DreamerConfig
+
+__all__ += ["Dreamer", "DreamerConfig"]

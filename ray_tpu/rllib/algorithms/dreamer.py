@@ -1,0 +1,413 @@
+"""Dreamer — model-based RL: learn a latent world model, train the
+policy inside it.
+
+Equivalent of the reference's DreamerV3 (reference:
+rllib/algorithms/dreamerv3/dreamer_v3.py:1 — an RSSM world model
+[Hafner et al. 2023] trained on replayed sequences, with the
+actor-critic trained entirely on imagined latent rollouts). This is a
+deliberately compact instantiation of the same architecture —
+GRU-deterministic + gaussian-stochastic RSSM, decoder/reward/continue
+heads, lambda-return critic and REINFORCE actor over imagined
+trajectories — sized for the in-tree control envs, not Atari. TPU-first
+shape: BOTH phases are single jitted updates whose recurrences (sequence
+posterior rollout, imagination rollout) are `lax.scan`s; nothing steps
+the real env inside jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.replay_buffer import SequenceReplayBuffer
+from ray_tpu.rllib.rl_module import _gru_init, _gru_step, _init_linear, _mlp
+
+
+def _mlp_params(rng, dims, out_scale=1.0):
+    layers = [_init_linear(rng, dims[i], dims[i + 1], np.sqrt(2))
+              for i in range(len(dims) - 2)]
+    layers.append(_init_linear(rng, dims[-2], dims[-1], out_scale))
+    return layers
+
+
+class DreamerModule:
+    """RSSM world model + latent actor-critic, one param tree.
+
+    Latent state = (h deterministic [H], z stochastic gaussian [Z]).
+    posterior q(z|h, embed(obs)); prior p(z|h); heads decode obs, reward
+    and continue from (h, z); actor/critic read (h, z).
+    """
+
+    is_recurrent = True  # EnvRunner threads (h, z) through rollouts
+
+    def __init__(self, obs_dim: int, num_actions: int, h_dim: int = 64,
+                 z_dim: int = 16, hidden: int = 64):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.h_dim = h_dim
+        self.z_dim = z_dim
+        self.hidden = hidden
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        H, Z, A, D = self.h_dim, self.z_dim, self.num_actions, self.obs_dim
+        n = self.hidden
+        return {
+            "enc": _mlp_params(rng, [D, n, n]),
+            "gru": _gru_init(rng, Z + A, H),
+            "prior": _mlp_params(rng, [H, n, 2 * Z], 0.1),
+            "post": _mlp_params(rng, [H + n, n, 2 * Z], 0.1),
+            "dec": _mlp_params(rng, [H + Z, n, D]),
+            # reward/continue condition on (state, action): the MuZero-ish
+            # factorization keeps every training pair inside one episode
+            # (no next-state needed), and imagination scores identically
+            "rew": _mlp_params(rng, [H + Z + A, n, 1], 0.1),
+            "cont": _mlp_params(rng, [H + Z + A, n, 1], 0.1),
+            "actor": _mlp_params(rng, [H + Z, n, A], 0.01),
+            "critic": _mlp_params(rng, [H + Z, n, 1], 0.1),
+        }
+
+    def initial_state(self, batch: int) -> np.ndarray:
+        # packed (h, z, prev_action_onehot) so the EnvRunner's generic
+        # state threading carries the action conditioning too — the
+        # filter the policy deploys on matches the one it trains on
+        return np.zeros(
+            (batch, self.h_dim + self.z_dim + self.num_actions), np.float32)
+
+    # -- shared math (xp = np | jnp) --
+
+    def _split_stats(self, xp, stats):
+        mean, log_std = stats[..., :self.z_dim], stats[..., self.z_dim:]
+        return mean, xp.clip(log_std, -5.0, 2.0)
+
+    def _step_core(self, xp, params, state, action_onehot, embed, noise):
+        """(h,z) + a + embed(obs) -> next packed state via the POSTERIOR."""
+        h, z = state[..., :self.h_dim], state[..., self.h_dim:]
+        h = _gru_step(xp, params["gru"],
+                      xp.concatenate([z, action_onehot], -1), h)
+        stats = _mlp(xp, params["post"], xp.concatenate([h, embed], -1))
+        mean, log_std = self._split_stats(xp, stats)
+        z = mean + xp.exp(log_std) * noise
+        return xp.concatenate([h, z], -1)
+
+    # -- numpy path (EnvRunner action sampling) --
+
+    def step_np(self, params, obs: np.ndarray, state: np.ndarray):
+        """Posterior filter step + actor logits; returns (logits for the
+        runner's argmax, next packed state). The state tail carries the
+        PREVIOUS action one-hot; the runner writes the action it actually
+        took via pack_action (exploration included)."""
+        B = obs.shape[0]
+        sz = self.h_dim + self.z_dim
+        embed = _mlp(np, params["enc"], obs)
+        a_prev = state[..., sz:]
+        nxt = self._step_core(np, params, state[..., :sz], a_prev, embed,
+                              np.zeros((B, self.z_dim), np.float32))
+        logits = _mlp(np, params["actor"], nxt)
+        # tail zeroed until the runner packs the chosen action
+        return logits, np.concatenate(
+            [nxt, np.zeros((B, self.num_actions), np.float32)], -1)
+
+    def pack_action(self, state: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        """Record the action the runner CHOSE (epsilon-greedy included) in
+        the state tail so the next filter step conditions on the truth."""
+        out = state.copy()
+        sz = self.h_dim + self.z_dim
+        out[..., sz:] = 0.0
+        out[np.arange(len(actions)), sz + actions] = 1.0
+        return out
+
+    # -- jax: world-model loss over [B, T] sequences --
+
+    def observe(self, params, obs_seq, actions, resets, packed_state0, key):
+        """Posterior rollout over a [B, T] sequence: returns states
+        [B,T,H+Z] and prior/post stats. The packed state0 carries the
+        window's true first prev-action; later steps shift `actions`."""
+        import jax
+        import jax.numpy as jnp
+
+        B, T, _ = obs_seq.shape
+        sz = self.h_dim + self.z_dim
+        state0 = packed_state0[..., :sz]
+        a0 = packed_state0[..., sz:]
+        act1 = jax.nn.one_hot(actions, self.num_actions)
+        act_onehot_seq = jnp.concatenate(
+            [a0[:, None, :], act1[:, :-1]], axis=1)
+        embed = _mlp(jnp, params["enc"], obs_seq)
+        noise = jax.random.normal(key, (T, B, self.z_dim))
+
+        def scan_step(state, inputs):
+            emb_t, act_t, reset_t, eps_t = inputs
+            state = jnp.where(reset_t[:, None], 0.0, state)
+            # a fresh episode has no previous action either
+            act_t = jnp.where(reset_t[:, None], 0.0, act_t)
+            h = state[..., :self.h_dim]
+            z = state[..., self.h_dim:]
+            h = _gru_step(jnp, params["gru"],
+                          jnp.concatenate([z, act_t], -1), h)
+            prior_stats = _mlp(jnp, params["prior"], h)
+            post_stats = _mlp(jnp, params["post"],
+                              jnp.concatenate([h, emb_t], -1))
+            mean, log_std = self._split_stats(jnp, post_stats)
+            z = mean + jnp.exp(log_std) * eps_t
+            nxt = jnp.concatenate([h, z], -1)
+            return nxt, (nxt, prior_stats, post_stats)
+
+        xs = (jnp.swapaxes(embed, 0, 1), jnp.swapaxes(act_onehot_seq, 0, 1),
+              jnp.swapaxes(resets, 0, 1), noise)
+        _, (states, prior_stats, post_stats) = jax.lax.scan(
+            scan_step, state0, xs)
+        swap = lambda x: jnp.swapaxes(x, 0, 1)  # noqa: E731
+        return swap(states), swap(prior_stats), swap(post_stats)
+
+    def imagine(self, params, start_states, horizon: int, key):
+        """Actor-driven PRIOR rollout from [N, H+Z] starts. Returns
+        (pre_states, rewards, conts, logps, entropies) each [N, horizon]
+        (+state dim) — rewards/continues scored from the (state, action)
+        heads exactly as trained."""
+        import jax
+        import jax.numpy as jnp
+
+        N = start_states.shape[0]
+        keys = jax.random.split(key, horizon)
+
+        def scan_step(state, k):
+            logits = _mlp(jnp, params["actor"], state)
+            ka, kz = jax.random.split(k)
+            action = jax.random.categorical(ka, logits)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, action[:, None], axis=-1)[:, 0]
+            entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, -1)
+            a1 = jax.nn.one_hot(action, self.num_actions)
+            sa = jnp.concatenate([state, a1], -1)
+            rew = _mlp(jnp, params["rew"], sa)[..., 0]
+            cont = jax.nn.sigmoid(_mlp(jnp, params["cont"], sa)[..., 0])
+            h = state[..., :self.h_dim]
+            z = state[..., self.h_dim:]
+            h = _gru_step(jnp, params["gru"],
+                          jnp.concatenate([z, a1], -1), h)
+            stats = _mlp(jnp, params["prior"], h)
+            mean, log_std = self._split_stats(jnp, stats)
+            z = mean + jnp.exp(log_std) * jax.random.normal(
+                kz, (N, self.z_dim))
+            nxt = jnp.concatenate([h, z], -1)
+            return nxt, (state, rew, cont, logp, entropy)
+
+        _, (pre_states, rews, conts, logps, ents) = jax.lax.scan(
+            scan_step, start_states, keys)
+        swap = lambda x: jnp.swapaxes(x, 0, 1)  # noqa: E731
+        return (swap(pre_states), swap(rews), swap(conts),
+                swap(logps), swap(ents))
+
+
+def world_model_loss(module, params, batch, config):
+    """Reconstruction + reward + continue + KL(post || prior) with free
+    bits (Hafner et al. 2023 eq. 4-5, gaussian instantiation).
+
+    Alignment: the transition into state t+1 consumes action a_t, so
+    reward r_t and the continue flag are predicted from states[t+1] —
+    the same post-transition convention the imagination rollout scores
+    with. Pairs that cross an episode boundary are masked out."""
+    import jax
+    import jax.numpy as jnp
+
+    B, T = batch["rewards"].shape
+    states, prior_stats, post_stats = module.observe(
+        params, batch["obs"], batch["actions"], batch["resets"],
+        batch["state_in"], batch["key"])
+    recon = _mlp(jnp, params["dec"], states)
+    recon_loss = jnp.mean(jnp.sum((recon - batch["obs"]) ** 2, -1))
+    # (state_t, a_t) -> r_t and continue: every pair lies inside one
+    # episode (auto-reset boundaries need no masking)
+    a_now = jax.nn.one_hot(batch["actions"], module.num_actions)
+    sa = jnp.concatenate([states, a_now], -1)
+    rew = _mlp(jnp, params["rew"], sa)[..., 0]
+    reward_loss = jnp.mean((rew - batch["rewards"]) ** 2)
+    cont_logit = _mlp(jnp, params["cont"], sa)[..., 0]
+    cont_target = 1.0 - batch["terminateds"].astype(jnp.float32)
+    cont_loss = jnp.mean(
+        jnp.maximum(cont_logit, 0) - cont_logit * cont_target
+        + jnp.log1p(jnp.exp(-jnp.abs(cont_logit))))
+    pm, pls = module._split_stats(jnp, prior_stats)
+    qm, qls = module._split_stats(jnp, post_stats)
+    kl = (pls - qls + (jnp.exp(2 * qls) + (qm - pm) ** 2)
+          / (2 * jnp.exp(2 * pls)) - 0.5)
+    kl = jnp.maximum(jnp.sum(kl, -1), config["free_bits"])
+    kl_loss = jnp.mean(kl)
+    loss = recon_loss + reward_loss + cont_loss + config["kl_coeff"] * kl_loss
+    return loss, {
+        "recon_loss": recon_loss, "reward_loss": reward_loss,
+        "kl": kl_loss, "cont_loss": cont_loss,
+        # flat posterior states ride out for the behavior phase
+        "_states": jax.lax.stop_gradient(states.reshape(B * T, -1)),
+    }
+
+
+def behavior_loss(module, params, batch, config):
+    """Imagination-phase actor-critic: lambda-return REINFORCE + value
+    regression, entirely in latent space (dreamer_v3.py training_step's
+    second phase). The world model is frozen here — `wm_params` ride in
+    the batch; only actor/critic entries of `params` receive gradients
+    (the loss touches nothing else)."""
+    import jax
+    import jax.numpy as jnp
+
+    wm = batch["wm_params"]
+    live = {k: v for k, v in wm.items() if k not in ("actor", "critic")}
+    live["actor"] = params["actor"]
+    live["critic"] = params["critic"]
+    pre_states, rew, cont, logps, ents = module.imagine(
+        live, batch["starts"], config["horizon"], batch["key"])
+    # values of the PRE-decision states v(s_i); bootstrap with the value
+    # of the final post-transition state approximated by the last pre
+    # state (one-step tail truncation, horizon is short)
+    value = _mlp(jnp, params["critic"], pre_states)[..., 0]   # [N, Hrz]
+    gamma, lam = config["gamma"], config["lambda"]
+    disc = gamma * cont
+
+    def lam_ret(carry, xs):
+        r_t, d_t, v_next = xs
+        ret = r_t + d_t * ((1 - lam) * v_next + lam * carry)
+        return ret, ret
+
+    # v_{i+1}: shift values left; tail bootstraps from its own value
+    v_next = jnp.concatenate([value[:, 1:], value[:, -1:]], axis=1)
+    _, rets = jax.lax.scan(
+        lam_ret, value[:, -1],
+        (rew.T[::-1], disc.T[::-1], v_next.T[::-1]))
+    returns = rets[::-1].T                                   # [N, Hrz]
+    adv = jax.lax.stop_gradient(returns - value)
+    # normalize by return scale (the V3 trick, percentile-lite)
+    scale = jnp.maximum(1.0, jnp.std(returns) + 1e-6)
+    actor_loss = -jnp.mean(logps * adv / scale
+                           + config["entropy"] * ents)
+    critic_loss = jnp.mean(
+        (value - jax.lax.stop_gradient(returns)) ** 2)
+    loss = actor_loss + critic_loss
+    return loss, {"actor_loss": actor_loss, "critic_loss": critic_loss,
+                  "imagined_return": jnp.mean(returns)}
+
+
+class DreamerConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.rollout_length = 16
+        self.buffer_capacity = 2_000   # sequences
+        self.learning_starts = 32
+        self.wm_updates = 16
+        self.behavior_updates = 16
+        self.seq_minibatch = 16
+        self.horizon = 10
+        self.kl_coeff = 0.5
+        self.free_bits = 1.0
+        self.entropy = 3e-3
+        self.lambda_ = 0.95
+        self.lr = 8e-4
+        self.h_dim = 64
+        self.z_dim = 16
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 3_000
+        self.algo_class = Dreamer
+
+
+class Dreamer(Algorithm):
+    runner_mode = "epsilon_greedy"  # actor logits argmax + annealed random
+
+    def _runner_factory(self):
+        cfg = self.config
+        h, z, n = cfg.h_dim, cfg.z_dim, cfg.hidden
+        hid = n[0] if isinstance(n, (tuple, list)) else n
+        return lambda obs_dim, n_act: DreamerModule(
+            obs_dim, n_act, h_dim=h, z_dim=z, hidden=hid)
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        hid = (cfg.hidden[0] if isinstance(cfg.hidden, (tuple, list))
+               else cfg.hidden)
+        self.module = DreamerModule(self.obs_dim, self.num_actions,
+                                    h_dim=cfg.h_dim, z_dim=cfg.z_dim,
+                                    hidden=hid)
+        self.wm_learner = Learner(
+            self.module, world_model_loss,
+            config={"kl_coeff": cfg.kl_coeff, "free_bits": cfg.free_bits},
+            learning_rate=cfg.lr, max_grad_norm=cfg.max_grad_norm,
+            seed=cfg.seed)
+        self.ac_learner = Learner(
+            self.module, behavior_loss,
+            config={"horizon": cfg.horizon, "gamma": cfg.gamma,
+                    "lambda": cfg.lambda_, "entropy": cfg.entropy},
+            learning_rate=cfg.lr, max_grad_norm=cfg.max_grad_norm,
+            seed=cfg.seed + 1)
+        self.learner = self.wm_learner  # primary (save_state adds the AC)
+        self.buffer = SequenceReplayBuffer(
+            cfg.buffer_capacity, cfg.rollout_length, self.obs_dim,
+            state_dim=cfg.h_dim + cfg.z_dim + self.num_actions,
+            seed=cfg.seed)
+        self._key = 0
+        self._broadcast()
+
+    def _sync_actor_into_wm(self) -> dict:
+        """One combined tree: world model + freshest actor/critic."""
+        wm = self.wm_learner.get_weights_np()
+        ac = self.ac_learner.get_weights_np()
+        wm["actor"] = ac["actor"]
+        wm["critic"] = ac["critic"]
+        return wm
+
+    def _broadcast(self) -> None:
+        self._broadcast_weights(self._sync_actor_into_wm(), self._epsilon())
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._total_env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def training_step(self) -> dict:
+        import jax
+
+        cfg = self.config
+        for b in self._sample_all():
+            self.buffer.add_rollout(b)
+        metrics_acc: dict[str, list[float]] = {}
+
+        def record(m: dict, prefix: str = "") -> None:
+            for k, v in m.items():
+                metrics_acc.setdefault(prefix + k, []).append(v)
+
+        states = None
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.wm_updates):
+                mb = self.buffer.sample(cfg.seq_minibatch)
+                self._key += 1
+                mb["key"] = jax.random.PRNGKey(self._key)
+                m = self.wm_learner.update(mb)
+                states = m.pop("_states")
+                record(m)
+            # behavior phase: its own update count, on the freshest
+            # posterior states, with the world model's DEVICE params (no
+            # per-update device<->host round trips)
+            for _ in range(cfg.behavior_updates if states is not None else 0):
+                self._key += 1
+                m2 = self.ac_learner.update({
+                    "starts": states,
+                    "wm_params": self.wm_learner.params,
+                    "key": jax.random.PRNGKey(self._key),
+                })
+                record(m2, prefix="ac_")
+        self._broadcast()
+        out = {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+        out["epsilon"] = self._epsilon()
+        out["replay_sequences"] = len(self.buffer)
+        return out
+
+    def save_state(self) -> dict:
+        state = super().save_state()
+        state["ac_learner"] = self.ac_learner.state()
+        return state
+
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        self.ac_learner.load_state(state["ac_learner"])
+        self._broadcast()

@@ -145,6 +145,11 @@ class EnvRunner:
                 random_a = self._rng.integers(0, self.vec.num_actions, size=E)
                 explore = self._rng.uniform(size=E) < self.epsilon
                 actions = np.where(explore, random_a, greedy).astype(np.int32)
+            if self._recurrent and hasattr(self.module, "pack_action"):
+                # modules whose filter conditions on the previous action
+                # (Dreamer's RSSM) record the CHOSEN action — exploration
+                # included — in the carried state
+                self._h = self.module.pack_action(self._h, actions)
             true_next_obs, rewards, dones, terms = self.vec.step(actions)
             batch["actions"][t] = actions
             batch["rewards"][t] = rewards

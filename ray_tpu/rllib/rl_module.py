@@ -29,15 +29,19 @@ def _init_linear(rng: np.random.Generator, n_in: int, n_out: int, scale: float):
     }
 
 
-def _mlp_jax(layers, x):
-    """jax twin of ActorCriticModule._mlp_np — shared by every module's
-    learner-side forward."""
-    import jax.numpy as jnp
-
+def _mlp(xp, layers, x):
+    """Backend-generic tanh-MLP forward (xp = np | jnp) — the single
+    implementation behind both rollout (numpy) and learner (jax) paths."""
     for layer in layers[:-1]:
-        x = jnp.tanh(x @ layer["w"] + layer["b"])
+        x = xp.tanh(x @ layer["w"] + layer["b"])
     last = layers[-1]
     return x @ last["w"] + last["b"]
+
+
+def _mlp_jax(layers, x):
+    import jax.numpy as jnp
+
+    return _mlp(jnp, layers, x)
 
 
 class ActorCriticModule:
