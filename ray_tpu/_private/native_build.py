@@ -13,7 +13,7 @@ import subprocess
 import threading
 
 _lock = threading.Lock()
-_cache: dict[str, str] = {}
+_cache: dict[tuple[str, str], str] = {}
 
 
 def build_native(
@@ -26,7 +26,10 @@ def build_native(
     exists; returns the artifact path. Safe under concurrent callers
     (atomic rename; same digest converges to the same path)."""
     with _lock:
-        cached = _cache.get(src)
+        # key by (src, out_name): one source builds multiple variants
+        # (production vs sanitizer-instrumented) and a src-only key would
+        # hand one variant's binary to the other's caller
+        cached = _cache.get((src, out_name))
         if cached and os.path.exists(cached):
             return cached
         with open(src, "rb") as f:
@@ -42,5 +45,5 @@ def build_native(
                 capture_output=True,
             )
             os.replace(tmp, out)
-        _cache[src] = out
+        _cache[(src, out_name)] = out
         return out

@@ -23,8 +23,14 @@ inline bool Fits(const double* demand, const double* avail, int n_res) {
   return true;
 }
 
-// available CPU fraction — the load signal the Python policy uses
-inline double AvailFrac(const double* avail, const double* total, int cpu_col) {
+// available CPU fraction — the load signal the Python policy uses. A
+// missing/out-of-range CPU column reads as fully available rather than
+// indexing out of bounds (found by the ASAN fuzz driver in
+// tests/test_sanitizers.py; the same bounds discipline rt_pick_node
+// already applies to local_index).
+inline double AvailFrac(const double* avail, const double* total, int cpu_col,
+                        int n_res) {
+  if (cpu_col < 0 || cpu_col >= n_res) return 1.0;
   double cpu_total = total[cpu_col];
   if (cpu_total == 0) cpu_total = 1.0;
   return avail[cpu_col] / cpu_total;
@@ -53,7 +59,7 @@ int rt_pick_node(const double* demand, int n_res, const double* avail,
     if (!alive[i]) continue;
     const double* a = avail + (int64_t)i * n_res;
     if (!Fits(demand, a, n_res)) continue;
-    double frac = AvailFrac(a, total + (int64_t)i * n_res, cpu_col);
+    double frac = AvailFrac(a, total + (int64_t)i * n_res, cpu_col, n_res);
     if (best == -1 ||
         (strategy == 1 ? frac > best_frac : frac < best_frac)) {
       best = i;

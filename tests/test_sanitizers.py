@@ -1,0 +1,154 @@
+"""Race/memory-sanitizer builds of the native components (SURVEY.md §5.2;
+reference: Ray's CI runs TSAN/ASAN build configs over the C++ core rather
+than shipping sanitizer code in-tree — same approach here: the SAME
+sources compile under -fsanitize and run a concurrency-heavy workload;
+any data race or heap error fails the test through the sanitizer's
+report."""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.native_build import build_native
+from ray_tpu._private.object_store import _CPP_DIR, ObjectStoreClient
+
+STORE_SRC = os.path.join(_CPP_DIR, "store.cpp")
+SCHED_SRC = os.path.join(_CPP_DIR, "sched.cpp")
+
+
+def _run_store_workload(binary: str, tmp_path, env_extra: dict) -> str:
+    """Spawn the (sanitized) store daemon, hammer it from concurrent
+    clients with create/seal/get/wait/delete under LRU pressure, then
+    shut down cleanly. Returns the daemon's captured stderr."""
+    sock = str(tmp_path / "store.sock")
+    errfile = open(tmp_path / "store.err", "wb")
+    proc = subprocess.Popen(
+        [binary, sock, str(4 * 1024 * 1024), str(tmp_path / "spill"), "1024"],
+        stdout=subprocess.PIPE, stderr=errfile,
+        env={**os.environ, **env_extra},
+    )
+    try:
+        assert b"READY" in proc.stdout.readline()
+
+        def worker(seed: int):
+            rng = np.random.default_rng(seed)
+            client = ObjectStoreClient(sock)
+            for i in range(120):
+                oid = ObjectID(bytes([seed]) + rng.bytes(15))
+                size = int(rng.integers(1024, 256 * 1024))
+                try:
+                    buf = client.create(oid, size)
+                    buf[:8] = b"x" * 8
+                    client.seal(oid)
+                    if i % 3 == 0:
+                        got = client.get(oid, timeout_ms=100)
+                        del got
+                    if i % 5 == 0:
+                        client.wait_objects([oid], 1, timeout_ms=50)
+                    if i % 4 == 0:
+                        client.delete(oid)
+                except Exception:
+                    # pressure-evicted/failed creates are fine; the test's
+                    # subject is the sanitizer report, not the workload
+                    pass
+            client.close()
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        errfile.close()
+    time.sleep(0.2)
+    return (tmp_path / "store.err").read_bytes().decode(errors="replace")
+
+
+@pytest.mark.slow
+def test_store_daemon_clean_under_tsan(tmp_path):
+    binary = build_native(
+        STORE_SRC, "ray_tpu_store_tsan",
+        ["-O1", "-g", "-std=c++17", "-pthread", "-fsanitize=thread"],
+        ["-lrt"])
+    err = _run_store_workload(
+        binary, tmp_path,
+        {"TSAN_OPTIONS": "halt_on_error=0 exitcode=66"})
+    assert "ThreadSanitizer" not in err, f"data race(s):\n{err[:4000]}"
+
+
+@pytest.mark.slow
+def test_store_daemon_clean_under_asan(tmp_path):
+    binary = build_native(
+        STORE_SRC, "ray_tpu_store_asan",
+        ["-O1", "-g", "-std=c++17", "-pthread", "-fsanitize=address"],
+        ["-lrt"])
+    err = _run_store_workload(
+        binary, tmp_path,
+        {"ASAN_OPTIONS": "detect_leaks=0 exitcode=66"})
+    assert "AddressSanitizer" not in err, f"heap error(s):\n{err[:4000]}"
+
+
+SCHED_DRIVER = r"""
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+extern "C" int rt_pick_node(const double*, int, const double*, const double*,
+                            const uint8_t*, int, int, int, int);
+int main() {
+    srand(7);
+    for (int trial = 0; trial < 2000; trial++) {
+        int n = 1 + rand() % 64, r = 1 + rand() % 8;
+        std::vector<double> avail(n * r), total(n * r), demand(r);
+        std::vector<uint8_t> alive(n);
+        for (int i = 0; i < n * r; i++) {
+            total[i] = rand() % 16;
+            avail[i] = total[i] ? rand() % (int)(total[i] + 1) : 0;
+        }
+        for (int i = 0; i < r; i++) demand[i] = rand() % 4;
+        for (int i = 0; i < n; i++) alive[i] = rand() % 2;
+        int cpu_col = (rand() % (r + 2)) - 1;      // covers -1 AND >= r
+        int strategy = rand() % 3;
+        int local_index = (rand() % (n + 1)) - 1;  // -1 = no local node
+        int pick = rt_pick_node(demand.data(), r, avail.data(), total.data(),
+                                alive.data(), n, cpu_col, strategy,
+                                local_index);
+        if (pick < -1 || pick >= n) { printf("BAD %d\n", pick); return 2; }
+    }
+    printf("SCHED_OK\n");
+    return 0;
+}
+"""
+
+
+@pytest.mark.slow
+def test_scheduler_core_clean_under_asan(tmp_path):
+    """The C++ scheduler kernel fuzzed under ASAN+UBSAN: out-of-bounds
+    indexing on the packed resource matrices is exactly the bug class
+    this core risks."""
+    driver = tmp_path / "driver.cpp"
+    driver.write_text(SCHED_DRIVER)
+    out = tmp_path / "sched_asan"
+    subprocess.run(
+        ["g++", "-O1", "-g", "-fsanitize=address,undefined",
+         str(driver), SCHED_SRC, "-o", str(out)],
+        check=True, capture_output=True)
+    r = subprocess.run([str(out)], capture_output=True, text=True,
+                       timeout=120,
+                       env={**os.environ, "ASAN_OPTIONS": "detect_leaks=0"})
+    assert r.returncode == 0, (r.stdout, r.stderr[-3000:])
+    assert "SCHED_OK" in r.stdout
+    assert "AddressSanitizer" not in r.stderr and "runtime error" not in r.stderr, r.stderr[:3000]
