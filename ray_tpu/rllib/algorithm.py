@@ -264,16 +264,33 @@ class Algorithm:
     # -- checkpointing (Trainable save/restore surface) --
 
     def save_state(self) -> dict:
-        return {
+        """Learner weights + the off-policy bookkeeping subclasses keep by
+        convention (_target_params / _grad_steps / _env_steps) — a restore
+        must not compute TD targets against a random target net or reset
+        exploration annealing. Replay buffers are deliberately NOT
+        persisted (matching the reference's default checkpoints)."""
+        state = {
             "iteration": self.iteration,
             "total_env_steps": self._total_env_steps,
             "learner": self.learner.state(),
         }
+        if getattr(self, "_target_params", None) is not None:
+            state["target_params"] = self._target_params
+        for attr in ("_grad_steps", "_env_steps"):
+            if hasattr(self, attr):
+                state[attr.lstrip("_")] = getattr(self, attr)
+        return state
 
     def load_state(self, state: dict) -> None:
         self.iteration = state["iteration"]
         self._total_env_steps = state["total_env_steps"]
         self.learner.load_state(state["learner"])
+        if "target_params" in state and hasattr(self, "_target_params"):
+            self._target_params = state["target_params"]
+        for attr in ("_grad_steps", "_env_steps"):
+            key = attr.lstrip("_")
+            if key in state and hasattr(self, attr):
+                setattr(self, attr, state[key])
 
     @classmethod
     def as_trainable(cls, base_config: AlgorithmConfig, stop_iters: int = 10):

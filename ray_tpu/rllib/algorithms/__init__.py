@@ -50,3 +50,11 @@ __all__ += ["AlphaZero", "AlphaZeroConfig", "TicTacToe"]
 from ray_tpu.rllib.algorithms.dreamer import Dreamer, DreamerConfig
 
 __all__ += ["Dreamer", "DreamerConfig"]
+
+from ray_tpu.rllib.algorithms.slateq import (
+    RecSysEnv,
+    SlateQ,
+    SlateQConfig,
+)
+
+__all__ += ["RecSysEnv", "SlateQ", "SlateQConfig"]
