@@ -13,7 +13,6 @@ import subprocess
 import threading
 
 _lock = threading.Lock()
-_cache: dict[tuple[str, str], str] = {}
 
 
 def build_native(
@@ -26,14 +25,20 @@ def build_native(
     exists; returns the artifact path. Safe under concurrent callers
     (atomic rename; same digest converges to the same path)."""
     with _lock:
-        # key by (src, out_name): one source builds multiple variants
-        # (production vs sanitizer-instrumented) and a src-only key would
-        # hand one variant's binary to the other's caller
-        cached = _cache.get((src, out_name))
-        if cached and os.path.exists(cached):
-            return cached
+        # no memoized early-return: the digest MUST be recomputed per call
+        # or an in-process edit to a header would keep serving the stale
+        # binary; hashing a few small sources is microseconds
+        hasher = hashlib.sha256()
         with open(src, "rb") as f:
-            digest = hashlib.sha256(f.read()).hexdigest()[:12]
+            hasher.update(f.read())
+        # sibling headers are part of the translation unit: an edit to
+        # util.hpp must rebuild every binary that includes it
+        src_dir = os.path.dirname(src)
+        for name in sorted(os.listdir(src_dir)):
+            if name.endswith((".hpp", ".h")):
+                with open(os.path.join(src_dir, name), "rb") as f:
+                    hasher.update(f.read())
+        digest = hasher.hexdigest()[:12]
         build_dir = os.path.join(os.path.dirname(src), "build")
         os.makedirs(build_dir, exist_ok=True)
         out = os.path.join(build_dir, f"{out_name}.{digest}")
@@ -45,5 +50,4 @@ def build_native(
                 capture_output=True,
             )
             os.replace(tmp, out)
-        _cache[(src, out_name)] = out
         return out

@@ -45,6 +45,8 @@
 #include <string>
 #include <vector>
 
+#include "util.hpp"  // exponential backoff for connect retries (N18)
+
 namespace msgpk {
 
 // ---------------------------------------------------------------------------
@@ -251,12 +253,23 @@ class RpcClient {
     hints.ai_socktype = SOCK_STREAM;
     if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res)
       throw std::runtime_error("resolve failed: " + address);
-    fd_ = socket(res->ai_family, res->ai_socktype, 0);
-    if (fd_ < 0 || connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
-      freeaddrinfo(res);
-      throw std::runtime_error("connect failed: " + address);
+    // retry with exponential backoff: the head's services come up in
+    // order, and a frontend launched alongside them must not race the
+    // listener into a hard failure (reference: client reconnect backoff)
+    rt_util::ExponentialBackoff backoff(20, 2.0, 500);
+    bool connected = false;
+    for (int attempt = 0; attempt < 6; ++attempt) {
+      fd_ = socket(res->ai_family, res->ai_socktype, 0);
+      if (fd_ >= 0 && connect(fd_, res->ai_addr, res->ai_addrlen) == 0) {
+        connected = true;
+        break;
+      }
+      if (fd_ >= 0) close(fd_);
+      fd_ = -1;
+      if (attempt < 5) usleep((useconds_t)(backoff.Next() * 1000));
     }
     freeaddrinfo(res);
+    if (!connected) throw std::runtime_error("connect failed: " + address);
     int one = 1;
     setsockopt(fd_, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
     Handshake();

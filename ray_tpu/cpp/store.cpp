@@ -65,6 +65,13 @@
 #include <unordered_set>
 #include <vector>
 
+#include "util.hpp"  // structured events / throttling / counters (N18)
+
+namespace {
+rt_util::CounterMap g_counters;           // lifetime op counters
+rt_util::Throttler g_pressure_log(1000);  // >=1s between pressure events
+}  // namespace
+
 namespace {
 
 constexpr uint8_t OP_CREATE = 1, OP_SEAL = 2, OP_GET = 3, OP_RELEASE = 4,
@@ -366,6 +373,11 @@ class Store {
         objects_.erase(it);
         tombstones_.insert(victim);
         PushEventLocked(EV_EVICTED, victim);
+        g_counters.Inc("objects_evicted");
+        if (g_pressure_log.AbleToRun()) {
+          rt_util::Event("INFO", "store_lru_eviction",
+                         "\"used_bytes\":" + std::to_string(used_));
+        }
         continue;
       }
       if (spill_dir_.empty()) return false;
@@ -425,6 +437,13 @@ class Store {
     e.spilled = true;
     e.spill_path = path;
     used_ -= e.size;
+    g_counters.Inc("objects_spilled");
+    g_counters.Inc("bytes_spilled", e.size);
+    if (g_pressure_log.AbleToRun()) {
+      rt_util::Event("INFO", "store_spill",
+                     "\"bytes\":" + std::to_string(e.size) +
+                     ",\"used_bytes\":" + std::to_string(used_));
+    }
     return true;
   }
 
@@ -464,6 +483,7 @@ class Store {
     e.spilled = false;
     e.spill_path.clear();
     used_ += e.size;
+    g_counters.Inc("objects_restored");
     return true;
   }
 
@@ -702,7 +722,8 @@ int main(int argc, char **argv) {
   uint64_t min_spill = argc > 4 ? strtoull(argv[4], nullptr, 10) : 0;
   if (!spill_dir.empty() && mkdir(spill_dir.c_str(), 0700) != 0 &&
       errno != EEXIST) {
-    fprintf(stderr, "cannot create spill dir %s\n", spill_dir.c_str());
+    rt_util::Event("WARNING", "store_spill_dir_unusable",
+                   "\"dir\":\"" + rt_util::JsonEscape(spill_dir) + "\"");
     spill_dir.clear();
   }
   if (!spill_dir.empty()) {
@@ -711,10 +732,14 @@ int main(int argc, char **argv) {
     // exist in more than one store — files must never clobber across stores
     spill_dir += "/pid" + std::to_string(getpid());
     if (mkdir(spill_dir.c_str(), 0700) != 0 && errno != EEXIST) {
-      fprintf(stderr, "cannot create spill dir %s\n", spill_dir.c_str());
+      rt_util::Event("WARNING", "store_spill_dir_unusable",
+                     "\"dir\":\"" + rt_util::JsonEscape(spill_dir) + "\"");
       spill_dir.clear();
     }
   }
+  rt_util::Event("INFO", "store_started",
+                 "\"capacity_bytes\":" + std::to_string(capacity) +
+                 ",\"spill\":" + (spill_dir.empty() ? "false" : "true"));
   Store store(capacity, spill_dir, min_spill);
   g_store = &store;
   g_sock_path = sock_path;
@@ -754,5 +779,6 @@ int main(int argc, char **argv) {
   store.StopNotifier();
   store.UnlinkAll();
   unlink(sock_path);
+  rt_util::Event("INFO", "store_shutdown", g_counters.ToJsonFields());
   return 0;
 }

@@ -40,7 +40,7 @@ def _run_store_workload(binary: str, tmp_path, env_extra: dict) -> str:
             rng = np.random.default_rng(seed)
             client = ObjectStoreClient(sock)
             for i in range(120):
-                oid = ObjectID(bytes([seed]) + rng.bytes(15))
+                oid = ObjectID(bytes([seed]) + rng.bytes(ObjectID.SIZE - 1))
                 size = int(rng.integers(1024, 256 * 1024))
                 try:
                     buf = client.create(oid, size)
