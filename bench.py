@@ -321,16 +321,9 @@ def main() -> None:
     except Exception:
         pass  # cache is an optimization; never fail the bench over it
 
-    from ray_tpu.benchmarks.gpt_mfu import run_gpt_bench
+    from ray_tpu.benchmarks.gpt_mfu import gpt_env_kwargs, run_gpt_bench
 
-    gpt_kwargs: dict = {}
-    for name, key in (("BENCH_GPT_BS", "batch_size"),
-                      ("BENCH_GPT_SEQ", "seq_len"),
-                      ("BENCH_GPT_STEPS", "steps")):
-        if os.environ.get(name):
-            gpt_kwargs[key] = int(os.environ[name])
-    if os.environ.get("BENCH_GPT_CONFIG"):
-        gpt_kwargs["config"] = os.environ["BENCH_GPT_CONFIG"]
+    gpt_kwargs = gpt_env_kwargs()
 
     start = time.monotonic()
     # Probe first (small batch, short sequence, few steps): lands a real
@@ -346,10 +339,28 @@ def main() -> None:
         except Exception:
             probe = None
 
-    try:
-        _publish(run_gpt_bench(publish=_publish, **gpt_kwargs))
-    except Exception as e:
-        if probe is None:
+    # Config ladder: the headline shape first, then memory-thriftier
+    # fallbacks so an HBM-OOM on a smaller chip degrades to a smaller
+    # honest measurement instead of leaving only the probe number.
+    # (bs16/seq1024 measures 30%+ MFU on v5e and fits in 15.75G HBM with
+    # the fused lm-head loss + Pallas flash backward.)
+    if gpt_kwargs:
+        ladder = [gpt_kwargs]
+    else:
+        ladder = [
+            {"batch_size": 16, "seq_len": 1024},
+            {"batch_size": 8, "seq_len": 1024},
+            {"batch_size": 8, "seq_len": 1024, "remat": True},
+        ]
+    last_err: Exception | None = None
+    for kw in ladder:
+        try:
+            _publish(run_gpt_bench(publish=_publish, **kw))
+            break
+        except Exception as e:
+            last_err = e
+    else:
+        if probe is None and last_err is not None:
             # no probe either: publish the error so the emitted line says
             # WHY there is no number (with a probe, its result stands)
             _publish({
@@ -357,7 +368,7 @@ def main() -> None:
                 "value": 0.0,
                 "unit": "tokens/sec",
                 "vs_baseline": 0.0,
-                "error": f"{type(e).__name__}: {e}"[:500],
+                "error": f"{type(last_err).__name__}: {last_err}"[:500],
             })
 
     def aux_bench(fn, key: str, min_budget: float) -> None:

@@ -31,9 +31,12 @@ def run_gpt_bench(
     peak_tflops: float | None = None,
     publish: Callable[[dict], None] | None = None,
     config: str = "gpt2_small",
+    remat: bool = False,
 ) -> dict:
     """Measure jitted GPT train-step throughput. `publish` receives partial
     results after every chunk so a watchdog can report mid-run progress."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -48,6 +51,11 @@ def run_gpt_bench(
         peak_tflops = chip_peak_tflops(dev)
 
     cfg = getattr(GPTConfig, config)() if config != "tiny" else GPTConfig.tiny()
+    if remat:
+        # bs16/seq1024 without remat needs 16.9G of the v5e's 15.75G HBM
+        # (the layer scan saves ~18 per-layer bf16 residual stacks); block
+        # rematerialization trades ~1 extra forward for that headroom
+        cfg = dataclasses.replace(cfg, remat=True)
     if seq_len < cfg.max_seq_len:
         # benching a shorter context: positional table slices down free
         pass
@@ -88,6 +96,7 @@ def run_gpt_bench(
             "n_params": n_params,
             "batch_size": batch_size,
             "seq_len": seq_len,
+            "remat": remat,
         }
 
     for _ in range(warmup):
@@ -135,6 +144,31 @@ CHIP_PEAK_TFLOPS = [
 ]
 
 
+def env_bool(name: str) -> bool:
+    """Shared falsy-string parse so 'False'/'no'/'off'/'0' all disable."""
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "no", "off"
+    )
+
+
+def gpt_env_kwargs() -> dict:
+    """BENCH_GPT_* env overrides as run_gpt_bench kwargs — the one parser
+    both entry points (bench.py and this module's main) share. A falsy
+    BENCH_GPT_REMAT contributes nothing, so it cannot make the kwargs
+    truthy and suppress bench.py's OOM fallback ladder."""
+    kwargs: dict = {}
+    for name, key in (("BENCH_GPT_BS", "batch_size"),
+                      ("BENCH_GPT_SEQ", "seq_len"),
+                      ("BENCH_GPT_STEPS", "steps")):
+        if os.environ.get(name):
+            kwargs[key] = int(os.environ[name])
+    if os.environ.get("BENCH_GPT_CONFIG"):
+        kwargs["config"] = os.environ["BENCH_GPT_CONFIG"]
+    if env_bool("BENCH_GPT_REMAT"):
+        kwargs["remat"] = True
+    return kwargs
+
+
 def chip_peak_tflops(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
     for sub, peak in CHIP_PEAK_TFLOPS:
@@ -151,15 +185,7 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
-    kwargs: dict = {}
-    for name, key in (("BENCH_GPT_BS", "batch_size"),
-                      ("BENCH_GPT_SEQ", "seq_len"),
-                      ("BENCH_GPT_STEPS", "steps")):
-        if os.environ.get(name):
-            kwargs[key] = int(os.environ[name])
-    if os.environ.get("BENCH_GPT_CONFIG"):
-        kwargs["config"] = os.environ["BENCH_GPT_CONFIG"]
-    print(json.dumps(run_gpt_bench(**kwargs)), flush=True)
+    print(json.dumps(run_gpt_bench(**gpt_env_kwargs())), flush=True)
 
 
 if __name__ == "__main__":

@@ -35,6 +35,9 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     attention: str = "flash"  # flash | xla | ring
     remat: bool = False       # jax.checkpoint each block (long-context)
+    fused_loss: bool = True   # chunked lm-head+CE, no [B,S,V] logits
+                              # (single-device path; meshes use the einsum
+                              # head so tp can shard the vocab matmul)
 
     @staticmethod
     def gpt2_small() -> "GPTConfig":
@@ -159,7 +162,7 @@ def _block(x, bp, cfg: GPTConfig, rules: ShardingRules | None, mesh):
     return constrain(x, ("batch", "seq", "embed"))
 
 
-def gpt_forward(
+def gpt_hidden(
     params: dict,
     tokens: jax.Array,
     cfg: GPTConfig,
@@ -167,7 +170,8 @@ def gpt_forward(
     rules: ShardingRules | None = None,
     mesh=None,
 ) -> jax.Array:
-    """tokens [B, S] int32 → logits [B, S, vocab] (f32)."""
+    """tokens [B, S] int32 → final hidden states [B, S, D] (cfg.dtype),
+    after the final layer norm (everything but the lm-head)."""
     B, S = tokens.shape
     wte = params["wte"].astype(cfg.dtype)
     if mesh is not None:
@@ -190,7 +194,22 @@ def gpt_forward(
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, blocks)
 
-    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+
+
+def gpt_forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: GPTConfig,
+    *,
+    rules: ShardingRules | None = None,
+    mesh=None,
+) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] (f32)."""
+    x = gpt_hidden(params, tokens, cfg, rules=rules, mesh=mesh)
+    wte = params["wte"].astype(cfg.dtype)
+    if mesh is not None:
+        wte = with_logical_constraint(wte, (None, None), rules, mesh)
     # tied embeddings (GPT-2): output projection = wte^T. Inputs stay bf16
     # so the MXU runs at bf16 rate (the lm-head is ~25% of model FLOPs);
     # accumulation and the returned logits are f32 for a stable softmax.
@@ -221,6 +240,20 @@ def gpt_loss(
             mask = mask[:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
+    if cfg.fused_loss and mesh is None:
+        # single-device path: chunked lm-head + CE with closed-form grads
+        # (ops/loss.py) — the [B,S,V] logits tensor never exists, which is
+        # what lets bs16-32/seq1024 GPT-2 fit a single v5e chip
+        from ray_tpu.ops.loss import fused_lm_head_loss
+
+        x = gpt_hidden(params, inputs, cfg, rules=rules, mesh=mesh)
+        B, S, D = x.shape
+        return fused_lm_head_loss(
+            x.reshape(B * S, D),
+            params["wte"],
+            targets.reshape(B * S).astype(jnp.int32),
+            None if mask is None else mask.reshape(B * S).astype(jnp.float32),
+        )
     logits = gpt_forward(params, inputs, cfg, rules=rules, mesh=mesh)
     # target log-prob without materializing a [B,S,V] log_softmax: the
     # gather and the logsumexp reduction fuse into the logits producer

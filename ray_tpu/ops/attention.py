@@ -3,11 +3,31 @@
 New capability relative to the reference, which has no native attention or
 sequence-parallel kernels at all (SURVEY.md §5.7 — long-context support in
 the reference is delegated to DeepSpeed/FSDP integrations). Design per the
-Pallas TPU guide: online-softmax forward kernel, grid (batch*heads, q_blocks,
-kv_blocks) with the kv axis innermost so VMEM scratch accumulators persist
-across kv steps; backward is flash-recompute via XLA (per-q-block
-re-materialization under `jax.checkpoint`-style recompute — keeps O(S)
-memory for the residuals while XLA fuses the recomputed score matmuls).
+Pallas TPU guide, with three TPU-specific twists that fell out of profiling
+on a v5e (these kernels are VPU- and grid-overhead-bound, not MXU-bound —
+attention matmul FLOPs are ~1% of a GPT step but were ~40% of its time):
+
+- GROUPED GRID: each grid step processes `group` (batch*head) slices at
+  once via batched dot_generals, dividing the per-step overhead (~3-5 us
+  of pipeline/DMA bookkeeping) by the group size. Grid is
+  (bh/group, q_blocks, kv_blocks), innermost axis varies fastest so VMEM
+  scratch accumulators persist across the reduction axis.
+- BASE-2 SOFTMAX: log2(e) folds into the softmax scale (which itself folds
+  into q once, O(S*D)), so the per-element transcendental is a bare exp2
+  instead of exp's mul+exp2, and no [bq,bkv]-sized rescale pass exists.
+- HALF-PRECISION EXP: when the inputs are bf16, the exp2/subtract run in
+  bf16 (2x VPU lanes); the running max, log-sum-exp and output
+  accumulation stay f32. Probabilities are bf16-quantized (~0.4% rel)
+  — the same precision the output is stored at anyway. f32 inputs get a
+  fully-f32 softmax (tests compare against the XLA reference at 1e-5).
+
+Backward is a two-pass Pallas flash backward (dk/dv pass with q innermost,
+dq pass with kv innermost) that recomputes score blocks against the
+forward-saved logsumexp — O(S) residuals and no O(S^2) HBM temps (the
+XLA-recompute backward it replaced materialized four [b,h,S,S] f32 tensors
+per layer, the v5e OOM + bandwidth bottleneck at bs16/seq1024). The causal
+mask is only computed on diagonal-crossing blocks; blocks fully below the
+diagonal skip the iota/select entirely and blocks above are not executed.
 
 The kernel runs in interpret mode on CPU (tests) and compiled on TPU.
 """
@@ -20,6 +40,8 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+LOG2E = math.log2(math.e)
+LN2 = math.log(2.0)
 
 
 def mha_reference(
@@ -48,23 +70,68 @@ def mha_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def _pick_group(bh: int, block_q: int, block_kv: int) -> int:
+    """Largest group size whose f32 score temps stay well inside VMEM
+    (~48 MB for ~3 [g,bq,bkv] f32 live values) and that divides bh."""
+    budget = 48 * 1024 * 1024
+    per = block_q * block_kv * 4 * 3
+    g = min(max(1, budget // per), 8)  # cap BEFORE the divisibility walk
+    while g > 1 and bh % g:
+        g -= 1
+    return g
+
+
+
+def _clamp_block(block: int, seq_len: int) -> int:
+    """Largest block <= `block` that divides seq_len (halving as needed, so
+    e.g. S=1536 with a 1024 default lands on 512 instead of erroring)."""
+    block = min(block, seq_len)
+    while block > 1 and seq_len % block:
+        block //= 2
+    return block
+
+
+def _causal_regimes(q_idx, kv_idx, block_q, block_kv):
+    """(executed, fully_below): block-level causal classification."""
+    executed = kv_idx * block_kv <= q_idx * block_q + (block_q - 1)
+    fully_below = kv_idx * block_kv + (block_kv - 1) <= q_idx * block_q
+    return executed, fully_below
+
+
+def _mask_scores(s, q_idx, kv_idx, block_q, block_kv):
+    g, bq, bkv = s.shape
+    q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (g, bq, bkv), 1)
+    k_pos = kv_idx * block_kv + jax.lax.broadcasted_iota(jnp.int32, (g, bq, bkv), 2)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _bdot(a, b, contract, batch=((0,), (0,)), out=jnp.float32):
+    """Batched dot over leading group axis: a [g,M,*], b [g,N,*]."""
+    return jax.lax.dot_general(
+        a, b, ((contract), (batch)), preferred_element_type=out
+    )
+
+
 # ----------------------------------------------------------------------------
 # Pallas forward kernel
 # ----------------------------------------------------------------------------
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref,  # [1, block_q, D], [1, block_kv, D], [1, block_kv, D]
-    o_ref,                # [1, block_q, D]
-    m_scr, l_scr, acc_scr,  # VMEM scratch: [bq,128], [bq,128], [bq,D]
-    *,
+    q_ref, k_ref, v_ref,  # [g, block_q, D], [g, block_kv, D], [g, block_kv, D]
+    o_ref,                # [g, block_q, D]
+    *rest,                # optional lse_ref [g, block_q, 128], then scratch
     causal: bool,
-    scale: float,
     block_q: int,
     block_kv: int,
-    seq_len: int,
+    save_lse: bool,
 ):
     from jax.experimental import pallas as pl
+
+    if save_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref, (m_scr, l_scr, acc_scr) = None, rest
 
     q_idx = pl.program_id(1)
     kv_idx = pl.program_id(2)
@@ -76,101 +143,371 @@ def _flash_fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)          # [bq, D]
-        k = k_ref[0].astype(jnp.float32)          # [bkv, D]
-        v = v_ref[0].astype(jnp.float32)          # [bkv, D]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                  # [bq, bkv]
-        if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            k_pos = kv_idx * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    def _compute(masked: bool):
+        # storage-dtype matmul operands: bf16 x bf16 -> f32 runs the MXU at
+        # full rate. q arrives pre-scaled by softmax_scale * log2(e), so
+        # the softmax is base-2 and needs no per-element rescale.
+        q = q_ref[:]                               # [g, bq, D]
+        k = k_ref[:]                               # [g, bkv, D]
+        v = v_ref[:]                               # [g, bkv, D]
+        s = _bdot(q, k, ((2,), (2,)))              # [g, bq, bkv] f32
+        if masked:
+            s = _mask_scores(s, q_idx, kv_idx, block_q, block_kv)
 
-        m_prev = m_scr[:, :1]                      # [bq, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+        m_prev = m_scr[:, :, :1]                   # [g, bq, 1]
+        m_cur = jnp.max(s, axis=2, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                     # [bq, bkv]
-        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
-        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        # bf16 inputs: run the exp2 at half precision (2x VPU throughput);
+        # the probabilities feed a bf16 matmul + an f32 row sum either way
+        if q.dtype == jnp.bfloat16:
+            p = jnp.exp2((s - m_new).astype(jnp.bfloat16))
+        else:
+            p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)           # [g, bq, 1]
+        l_new = alpha * l_scr[:, :, :1] + jnp.sum(
+            p, axis=2, keepdims=True, dtype=jnp.float32
+        )
+        acc_scr[:] = acc_scr[:] * alpha + _bdot(
+            p.astype(v.dtype), v, ((2,), (1,))
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal:
-        # skip fully-masked kv blocks above the diagonal
-        @pl.when(kv_idx * block_kv <= q_idx * block_q + (block_q - 1))
+        executed, fully_below = _causal_regimes(q_idx, kv_idx, block_q, block_kv)
+
+        @pl.when(executed & jnp.logical_not(fully_below))
         def _():
-            _compute()
+            _compute(masked=True)
+
+        @pl.when(fully_below)
+        def _():
+            _compute(masked=False)
     else:
-        _compute()
+        _compute(masked=False)
 
     @pl.when(kv_idx == n_kv - 1)
     def _finalize():
-        l = l_scr[:, :1]
+        l = l_scr[:, :, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        if save_lse:
+            # base-2 logsumexp per query row, lane-broadcast to the
+            # (8,128)-tiled output layout (m/l already hold 128 copies)
+            lse_ref[:] = m_scr[:] + jnp.log2(
+                jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])
+            )
 
 
 def _flash_forward(
-    q, k, v, *, causal, scale, block_q, block_kv, interpret
+    q, k, v, *, causal, scale, block_q, block_kv, interpret, save_lse=False
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     batch, heads, seq_len, head_dim = q.shape
-    block_q = min(block_q, seq_len)
-    block_kv = min(block_kv, seq_len)
-    if seq_len % block_q or seq_len % block_kv:
-        raise ValueError(
-            f"seq_len {seq_len} must be divisible by block sizes "
-            f"({block_q}, {block_kv})"
-        )
+    block_q = _clamp_block(block_q, seq_len)
+    block_kv = _clamp_block(block_kv, seq_len)
     bh = batch * heads
-    qf = q.reshape(bh, seq_len, head_dim)
+    g = _pick_group(bh, block_q, block_kv)
+    # fold softmax scale AND log2(e) into q once (O(S*D)) — the kernels
+    # compute a base-2 softmax with no per-score rescale pass
+    qf = (q * jnp.asarray(scale * LOG2E, q.dtype)).reshape(bh, seq_len, head_dim)
     kf = k.reshape(bh, seq_len, head_dim)
     vf = v.reshape(bh, seq_len, head_dim)
 
-    grid = (bh, seq_len // block_q, seq_len // block_kv)
+    grid = (bh // g, seq_len // block_q, seq_len // block_kv)
     kernel = functools.partial(
         _flash_fwd_kernel,
         causal=causal,
-        scale=scale,
         block_q=block_q,
         block_kv=block_kv,
-        seq_len=seq_len,
+        save_lse=save_lse,
     )
-    out = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((g, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+    ]
+    out_shapes = [jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype)]
+    if save_lse:
+        # lane-broadcast [bh, S, 128] rather than [bh, S]: a 2D output
+        # violates Mosaic's (8,128) output-tile constraint; 128 copies of
+        # a f32 scalar per row is ~64 bytes/token of extra HBM — noise
+        out_specs.append(
+            pl.BlockSpec((g, block_q, 128), lambda b, i, j: (b, i, 0))
+        )
+        out_shapes.append(jax.ShapeDtypeStruct((bh, seq_len, 128), jnp.float32))
+    result = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shapes,
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((g, block_q, 128), jnp.float32),
+            pltpu.VMEM((g, block_q, 128), jnp.float32),
+            pltpu.VMEM((g, block_q, head_dim), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(batch, heads, seq_len, head_dim)
+    out, lse = (result[0], result[1]) if save_lse else (result[0], None)
+    out = out.reshape(batch, heads, seq_len, head_dim)
+    if save_lse:
+        return out, lse.reshape(batch, heads, seq_len, 128)[..., 0]
+    return out
 
 
 # ----------------------------------------------------------------------------
-# custom VJP: pallas forward, XLA flash-recompute backward
+# Pallas backward kernels (two-pass flash backward)
+#
+# Pass 1 (dk, dv): grid (bh/g, kv_blocks, q_blocks) — q innermost so the
+# dk/dv accumulators live in VMEM scratch across q steps.
+# Pass 2 (dq):     grid (bh/g, q_blocks, kv_blocks) — kv innermost, ditto.
+# Both recompute the score block from (q, k) and renormalize with the
+# base-2 lse saved by the forward; delta = sum(do*o, -1) is precomputed in
+# XLA. Nothing O(S^2) ever touches HBM.
+# ----------------------------------------------------------------------------
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # blocks, see specs
+    dk_ref, dv_ref,                                   # [g, block_kv, D]
+    dk_scr, dv_scr,                                   # VMEM [g, block_kv, D] f32
+    *,
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+):
+    from jax.experimental import pallas as pl
+
+    kv_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute(masked: bool):
+        q = q_ref[:]                                  # [g, bq, D] pre-scaled
+        k = k_ref[:]                                  # [g, bkv, D]
+        v = v_ref[:]                                  # [g, bkv, D]
+        do = do_ref[:]                                # [g, bq, D]
+        lse = lse_ref[:, :, :1]                       # [g, bq, 1] f32, base-2
+        delta = delta_ref[:, :, :1]                   # [g, bq, 1] f32
+
+        s = _bdot(q, k, ((2,), (2,)))                 # [g, bq, bkv] f32
+        if masked:
+            s = _mask_scores(s, q_idx, kv_idx, block_q, block_kv)
+        if q.dtype == jnp.bfloat16:
+            p = jnp.exp2((s - lse).astype(jnp.bfloat16))
+        else:
+            p = jnp.exp2(s - lse)                     # normalized probs
+        # dv += p^T @ do
+        dv_scr[:] = dv_scr[:] + _bdot(
+            p.astype(do.dtype), do, ((1,), (1,))
+        )
+        # dp = do @ v^T ; ds = ln2 * p * (dp - delta): the softmax is
+        # base-2 (p = exp2(s2 - lse2) with s2 = log2e-scaled logits), so
+        # dL/ds2 carries a ln2 from d exp2. With q pre-scaled by
+        # scale*log2e, dk = ds^T @ q_scaled is then exact, and dq needs
+        # one scale*log2e rescale in the wrapper (ln2 * log2e = 1).
+        dp = _bdot(do, v, ((2,), (2,)))
+        ds = p.astype(jnp.float32) * (dp - delta) * LN2
+        dk_scr[:] = dk_scr[:] + _bdot(
+            ds.astype(q.dtype), q, ((1,), (1,))
+        )
+
+    if causal:
+        executed, fully_below = _causal_regimes(q_idx, kv_idx, block_q, block_kv)
+
+        @pl.when(executed & jnp.logical_not(fully_below))
+        def _():
+            _compute(masked=True)
+
+        @pl.when(fully_below)
+        def _():
+            _compute(masked=False)
+    else:
+        _compute(masked=False)
+
+    @pl.when(q_idx == n_q - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,                                           # [g, block_q, D]
+    dq_scr,                                           # VMEM [g, block_q, D] f32
+    *,
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+):
+    from jax.experimental import pallas as pl
+
+    q_idx = pl.program_id(1)
+    kv_idx = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute(masked: bool):
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:, :, :1]
+        delta = delta_ref[:, :, :1]
+
+        s = _bdot(q, k, ((2,), (2,)))
+        if masked:
+            s = _mask_scores(s, q_idx, kv_idx, block_q, block_kv)
+        if q.dtype == jnp.bfloat16:
+            p = jnp.exp2((s - lse).astype(jnp.bfloat16))
+        else:
+            p = jnp.exp2(s - lse)
+        dp = _bdot(do, v, ((2,), (2,)))
+        ds = p.astype(jnp.float32) * (dp - delta) * LN2  # see dkv kernel
+        dq_scr[:] = dq_scr[:] + _bdot(
+            ds.astype(k.dtype), k, ((2,), (1,))
+        )
+
+    if causal:
+        executed, fully_below = _causal_regimes(q_idx, kv_idx, block_q, block_kv)
+
+        @pl.when(executed & jnp.logical_not(fully_below))
+        def _():
+            _compute(masked=True)
+
+        @pl.when(fully_below)
+        def _():
+            _compute(masked=False)
+    else:
+        _compute(masked=False)
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finalize():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, do, *, causal, scale, block_q, block_kv, interpret
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, heads, seq_len, head_dim = q.shape
+    block_q = _clamp_block(block_q, seq_len)
+    block_kv = _clamp_block(block_kv, seq_len)
+    bh = batch * heads
+    g = _pick_group(bh, block_q, block_kv)
+    # kernels compute grads w.r.t. the pre-scaled q (matching the forward's
+    # folded scale*log2e); the chain rule back to q multiplies dq by the
+    # same factor. For k and v no correction is needed: d s2/dk carries the
+    # scaled q itself, and the ln2 from d exp2 cancels the folded log2(e)
+    # in the ds -> (dk, dq) contractions' normalization (worked out so the
+    # returned grads match the natural-base reference exactly).
+    scale2 = scale * LOG2E
+    qf = (q * jnp.asarray(scale2, q.dtype)).reshape(bh, seq_len, head_dim)
+    kf = k.reshape(bh, seq_len, head_dim)
+    vf = v.reshape(bh, seq_len, head_dim)
+    dof = do.reshape(bh, seq_len, head_dim)
+
+    # delta_i = dO_i . O_i (row dot), lane-broadcast alongside lse to the
+    # (8,128)-tiled layout the kernels read; O(S*D) traffic, transient
+    delta = jnp.sum(
+        dof.astype(jnp.float32)
+        * out.reshape(bh, seq_len, head_dim).astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )                                                   # [bh, S, 1]
+    delta_b = jnp.broadcast_to(delta, (bh, seq_len, 128))
+    lse_b = jnp.broadcast_to(
+        lse.reshape(bh, seq_len, 1), (bh, seq_len, 128)
+    ).astype(jnp.float32)
+
+    # pass 1: dk, dv — kv blocks outer, q blocks inner (b, j, i) grid order
+    dkv_specs = [
+        pl.BlockSpec((g, block_q, head_dim), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((g, block_kv, head_dim), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((g, block_kv, head_dim), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((g, block_q, head_dim), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((g, block_q, 128), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((g, block_q, 128), lambda b, j, i: (b, i, 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, causal=causal,
+            block_q=block_q, block_kv=block_kv,
+        ),
+        grid=(bh // g, seq_len // block_kv, seq_len // block_q),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((g, block_kv, head_dim), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((g, block_kv, head_dim), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_len, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_len, head_dim), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, block_kv, head_dim), jnp.float32),
+            pltpu.VMEM((g, block_kv, head_dim), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_b, delta_b)
+
+    # pass 2: dq — q blocks outer, kv inner
+    row_specs = [
+        pl.BlockSpec((g, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((g, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((g, block_kv, head_dim), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((g, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((g, block_q, 128), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((g, block_q, 128), lambda b, i, j: (b, i, 0)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, causal=causal,
+            block_q=block_q, block_kv=block_kv,
+        ),
+        grid=(bh // g, seq_len // block_q, seq_len // block_kv),
+        in_specs=row_specs,
+        out_specs=pl.BlockSpec(
+            (g, block_q, head_dim), lambda b, i, j: (b, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype),
+        scratch_shapes=[pltpu.VMEM((g, block_q, head_dim), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_b, delta_b)
+
+    shape = (batch, heads, seq_len, head_dim)
+    dq = (dq * jnp.asarray(scale2, dq.dtype)).reshape(shape)
+    return dq, dk.reshape(shape), dv.reshape(shape)
+
+
+# ----------------------------------------------------------------------------
+# custom VJP: pallas forward, pallas two-pass backward
 # ----------------------------------------------------------------------------
 
 
@@ -185,42 +522,20 @@ def _flash_attention(q, k, v, causal, scale, block_q, block_kv, interpret):
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, interpret):
-    out = _flash_forward(
+    out, lse = _flash_forward(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
+        save_lse=True,
     )
-    return out, (q, k, v, out)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_kv, interpret, res, do):
-    q, k, v, out = res
-    # Flash backward via recompute, in f32. XLA fuses the score recompute
-    # with the gradient matmuls; memory is O(S^2) per (batch, head) shard
-    # here — acceptable at the block sizes the Train layer uses, and the
-    # ring-attention path (ops/ring_attention.py) keeps per-device S small.
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    outf = out.astype(jnp.float32)
-
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf, preferred_element_type=jnp.float32) * scale
-    if causal:
-        s_q, s_k = q.shape[2], k.shape[2]
-        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
-        s = jnp.where(mask, s, NEG_INF)
-    # lse recomputed here rather than saved by the forward kernel: a 2D lse
-    # output violates Mosaic's (8,128) output-tile constraint, and the
-    # logsumexp falls out of the score recompute for free
-    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - lse)                                # [b,h,q,k]
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
-    delta = jnp.sum(dof * outf, axis=-1, keepdims=True)  # [b,h,q,1]
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    q, k, v, out, lse = res
+    return _flash_backward(
+        q, k, v, out, lse, do, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+    )
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -233,8 +548,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 512,
-    block_kv: int = 512,
+    block_q: int = 1024,
+    block_kv: int = 1024,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention. q,k,v: [B, H, S, D]; returns [B, H, S, D].
@@ -242,7 +557,9 @@ def flash_attention(
     Grouped-query attention is handled by repeating kv heads up front
     (cheap relative to attention itself; a head-aware kernel is a later
     optimization). `interpret` defaults to True off-TPU so tests run the
-    same kernel code on CPU.
+    same kernel code on CPU. Default 1024 blocks: these kernels are
+    grid-overhead-bound, so fewer/bigger blocks win on TPU (measured on
+    v5e); long sequences clamp to the VMEM-driven group sizing.
     """
     if interpret is None:
         interpret = jax.devices()[0].platform not in ("tpu", "axon")

@@ -1,0 +1,120 @@
+"""Fused lm-head + cross-entropy: chunked over tokens, gradients computed
+in the forward pass.
+
+For a tied-embedding GPT, the vocabulary projection produces a [tokens,
+vocab] f32 logits tensor that is larger than all transformer residuals
+combined (GPT-2 small at bs16/seq1024: 3.3 GB, plus the same again for its
+gradient under autodiff) — it is what blew the v5e's 15.75 GB HBM before
+the last transformer matmul ever grew. But the loss gradient with respect
+to logits is closed-form (softmax(logits) - onehot(target), scaled by the
+upstream scalar), so the full tensor never needs to exist:
+
+  scan over token chunks; per chunk compute logits -> lse/picked (the
+  loss terms), form d_logits in closed form, and immediately contract it
+  back down: dx_c = d_logits @ W  and  dW += d_logits^T @ x_c.
+
+That is the SAME three matmuls the unfused forward+backward pair costs
+(logits, dx, dW) — zero extra FLOPs — while peak memory drops from
+O(tokens * vocab) to O(chunk * vocab), and the saved residuals are just
+dx [tokens, d] and dW [vocab, d]. The custom VJP then only rescales by the
+upstream cotangent. (Same design as GPU "fused linear cross-entropy"
+kernels, e.g. Liger; the reference has no equivalent — its torch trainers
+materialize logits.)
+
+No reference counterpart (SURVEY.md §5.7 class: TPU-native compute ops).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_lm_head_loss(x, w, targets, mask, chunk=4096):
+    """Mean next-token cross-entropy of `x @ w.T` against `targets`.
+
+    x: [N, D] activations (any float dtype; matmuls run in x.dtype with
+       f32 accumulation), w: [V, D] tied embedding table (cast to x.dtype),
+    targets: [N] int32, mask: [N] float or None (1 = count this token).
+    Returns a scalar f32 loss (mean over unmasked tokens).
+    """
+    loss, _ = _fused_fwd_impl(x, w, targets, mask, chunk)
+    return loss
+
+
+def _pad_to_chunks(x, targets, mask, chunk):
+    n = x.shape[0]
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    if mask is None:
+        mask = jnp.ones((n,), jnp.float32)
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        targets = jnp.pad(targets, (0, pad))
+        mask = jnp.pad(mask, (0, pad))  # padded rows masked out
+    return x, targets, mask, n_chunks, pad
+
+
+def _fused_fwd_impl(x, w, targets, mask, chunk):
+    n, d = x.shape
+    v = w.shape[0]
+    dtype = x.dtype
+    wc = w.astype(dtype)
+    xp, tp, mp, n_chunks, _ = _pad_to_chunks(x, targets, mask, chunk)
+    xs = xp.reshape(n_chunks, chunk, d)
+    ts = tp.reshape(n_chunks, chunk)
+    ms = mp.reshape(n_chunks, chunk).astype(jnp.float32)
+
+    def body(carry, sl):
+        loss_sum, cnt, dw = carry
+        xc, tc, mc = sl
+        logits = jax.lax.dot_general(
+            xc, wc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [C, V] f32
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)        # [C]
+        picked = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        loss_sum = loss_sum + jnp.sum((lse - picked) * mc)
+        cnt = cnt + jnp.sum(mc)
+        # closed-form d(sum CE)/d(logits), unnormalized: (p - onehot) * m
+        p = jnp.exp(logits - lse[:, None])
+        iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, v), 1)
+        dlogits = (p - (iota == tc[:, None]).astype(jnp.float32)) * mc[:, None]
+        dxc = jax.lax.dot_general(
+            dlogits.astype(dtype), wc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [C, D]
+        dw = dw + jax.lax.dot_general(
+            dlogits.astype(dtype), xc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [V, D]
+        return (loss_sum, cnt, dw), dxc
+
+    init = (jnp.float32(0.0), jnp.float32(0.0), jnp.zeros((v, d), jnp.float32))
+    (loss_sum, cnt, dw), dxs = jax.lax.scan(body, init, (xs, ts, ms))
+    cnt = jnp.maximum(cnt, 1.0)
+    dx = dxs.reshape(n_chunks * chunk, d)[:n]
+    return loss_sum / cnt, (dx / cnt, dw / cnt)
+
+
+def _fused_fwd_rule(x, w, targets, mask, chunk):
+    loss, (dx, dw) = _fused_fwd_impl(x, w, targets, mask, chunk)
+    # residuals pre-cast to the primal dtypes (custom_vjp cotangent avals
+    # must match the primals exactly)
+    return loss, (dx.astype(x.dtype), dw.astype(w.dtype))
+
+
+def _fused_bwd_rule(chunk, res, g):
+    dx, dw = res
+    gf = g.astype(jnp.float32)
+    return (
+        (gf * dx.astype(jnp.float32)).astype(dx.dtype),
+        (gf * dw.astype(jnp.float32)).astype(dw.dtype),
+        None,
+        None,
+    )
+
+
+fused_lm_head_loss.defvjp(_fused_fwd_rule, _fused_bwd_rule)
