@@ -51,6 +51,10 @@ def run_gpt_bench(
         peak_tflops = chip_peak_tflops(dev)
 
     cfg = getattr(GPTConfig, config)() if config != "tiny" else GPTConfig.tiny()
+    # the bench runs the unrolled layer loop: XLA schedules across layer
+    # boundaries instead of paying the scan-carry tax in the backward
+    # (33%→43% MFU on v5e bs16/seq1024; see docs/MICROBENCHMARKS.md)
+    cfg = dataclasses.replace(cfg, scan_layers=env_bool("BENCH_GPT_SCAN"))
     if remat:
         # bs16/seq1024 without remat needs 16.9G of the v5e's 15.75G HBM
         # (the layer scan saves ~18 per-layer bf16 residual stacks); block

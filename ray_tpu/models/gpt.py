@@ -35,6 +35,14 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     attention: str = "flash"  # flash | xla | ring
     remat: bool = False       # jax.checkpoint each block (long-context)
+    scan_layers: bool = True  # lax.scan over blocks (one compiled body) vs a
+                              # fully unrolled Python loop. Unrolling lets XLA
+                              # schedule/fuse across layer boundaries instead
+                              # of round-tripping the scan carry: measured
+                              # 33%→43% MFU on GPT-2-small bs16/seq1024 on a
+                              # v5e — the backward pays the scan tax. Cost:
+                              # ~3x compile time; meshes with pipeline
+                              # parallelism need the scan form.
     fused_loss: bool = True   # chunked lm-head+CE, no [B,S,V] logits
                               # (single-device path; meshes use the einsum
                               # head so tp can shard the vocab matmul)
@@ -185,14 +193,15 @@ def gpt_hidden(
     if mesh is not None:
         x = with_logical_constraint(x, ("batch", "seq", "embed"), rules, mesh)
 
-    def body(x, bp):
-        out = _block(x, bp, cfg, rules, mesh)
-        return out, None
-
     blocks = params["blocks"]
+    body = lambda x, bp: _block(x, bp, cfg, rules, mesh)
     if cfg.remat:
         body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, blocks)
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(lambda c, bp: (body(c, bp), None), x, blocks)
+    else:
+        for i in range(cfg.n_layer):
+            x = body(x, jax.tree.map(lambda a: a[i], blocks))
 
     return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
 

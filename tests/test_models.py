@@ -24,6 +24,26 @@ def test_gpt_param_count_and_loss(jax_cpu):
     assert abs(float(loss) - float(jnp.log(cfg.vocab_size))) < 0.25
 
 
+def test_gpt_unrolled_layers_match_scan(jax_cpu):
+    """scan_layers=False (the bench's unrolled form — 33%→43% MFU on v5e)
+    is numerically identical to the default lax.scan form, fwd and bwd."""
+    import dataclasses
+    import jax, jax.numpy as jnp
+    from ray_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+
+    cfg = GPTConfig.tiny()
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+
+    cfg_u = dataclasses.replace(cfg, scan_layers=False)
+    l_scan, g_scan = jax.value_and_grad(gpt_loss)(params, batch, cfg)
+    l_unroll, g_unroll = jax.value_and_grad(gpt_loss)(params, batch, cfg_u)
+    assert abs(float(l_scan) - float(l_unroll)) < 1e-5
+    for a, b in zip(jax.tree.leaves(g_scan), jax.tree.leaves(g_unroll)):
+        assert jnp.allclose(a, b, atol=1e-4), "unrolled grads diverge from scan"
+
+
 @pytest.mark.parametrize("mesh_axes", [dict(dp=8), dict(dp=2, fsdp=2, tp=2), dict(fsdp=4, tp=2)])
 def test_gpt_sharded_training_converges(jax_cpu, mesh_axes):
     import jax, jax.numpy as jnp, optax
