@@ -56,9 +56,9 @@ def run_gpt_bench(
     # (33%→43% MFU on v5e bs16/seq1024; see docs/MICROBENCHMARKS.md)
     cfg = dataclasses.replace(cfg, scan_layers=env_bool("BENCH_GPT_SCAN"))
     if remat:
-        # bs16/seq1024 without remat needs 16.9G of the v5e's 15.75G HBM
-        # (the layer scan saves ~18 per-layer bf16 residual stacks); block
-        # rematerialization trades ~1 extra forward for that headroom
+        # last-rung fallback for smaller-HBM chips: per-block
+        # rematerialization trades ~1 extra forward for dropping the
+        # saved per-layer residuals (scan or unrolled alike)
         cfg = dataclasses.replace(cfg, remat=True)
     if seq_len < cfg.max_seq_len:
         # benching a shorter context: positional table slices down free

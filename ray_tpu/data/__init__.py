@@ -23,11 +23,13 @@ from ray_tpu.data.datasource import (
     read_huggingface,
     read_images,
     read_json,
+    read_mongo,
     read_numpy,
     read_parquet,
     read_sql,
     read_text,
     read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [
@@ -50,11 +52,13 @@ __all__ = [
     "read_huggingface",
     "read_images",
     "read_json",
+    "read_mongo",
     "read_numpy",
     "read_parquet",
     "read_sql",
     "read_text",
     "read_tfrecords",
+    "read_webdataset",
 ]
 
 

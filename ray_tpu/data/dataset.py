@@ -663,6 +663,58 @@ class Dataset:
 
         return self._write(path, w, "tfrecords")
 
+    def write_webdataset(self, path: str) -> List[str]:
+        """Rows -> WebDataset tar shards, one per block (reference:
+        dataset.py write_webdataset). Column names become member suffixes;
+        the sample key is the row's __key__ column or the row index.
+        bytes pass through; str/int/float are utf-8; dict/list go as
+        .json members (suffix forced if the column isn't named json)."""
+        import io
+        import json as _json
+        import tarfile
+
+        def w(t, p):
+            with tarfile.open(p, "w") as tf:
+                for i, row in enumerate(t.to_pylist()):
+                    key = str(row.pop("__key__", i))
+                    for col, val in row.items():
+                        if val is None:
+                            continue
+                        if isinstance(val, bytes):
+                            data = val
+                        elif isinstance(val, (dict, list)):
+                            data = _json.dumps(val).encode()
+                            if col != "json" and not col.endswith("json"):
+                                col = col + ".json"
+                        else:
+                            data = str(val).encode()
+                        info = tarfile.TarInfo(f"{key}.{col}")
+                        info.size = len(data)
+                        tf.addfile(info, io.BytesIO(data))
+
+        return self._write(path, w, "tar")
+
+    def write_mongo(self, uri: str, database: str, collection: str, *,
+                    client_factory=None) -> int:
+        """insert_many every block's rows (reference: dataset.py
+        write_mongo / MongoDatasink). ``client_factory`` as in read_mongo.
+        Returns the document count written."""
+        from ray_tpu.data.datasource import _mongo_client
+
+        self.materialize()
+        client = _mongo_client(uri, client_factory, "write_mongo")
+        total = 0
+        try:
+            coll = client[database][collection]
+            for ref, _meta in self._cached:
+                rows = ray_tpu.get(ref, timeout=600).to_pylist()
+                if rows:
+                    coll.insert_many(rows)
+                    total += len(rows)
+        finally:
+            client.close()
+        return total
+
     def write_sql(self, sql: str, connection_factory) -> int:
         """INSERT every row through a DBAPI-2 statement with positional
         placeholders, one executemany per block (reference: dataset.py

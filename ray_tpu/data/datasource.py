@@ -290,6 +290,105 @@ def read_sql(sql: str, connection_factory) -> Dataset:
     return Dataset([_Read([sql], read)])
 
 
+def read_webdataset(paths, *, decode: bool = True,
+                    suffixes: Optional[List[str]] = None,
+                    parallelism: int = -1) -> Dataset:
+    """WebDataset tar shards -> one row per sample (reference:
+    read_api.py read_webdataset / webdataset_datasource.py). A sample is
+    the group of tar members sharing the basename before the FIRST dot;
+    the remainder ("json", "txt", "cls", "jpg", ...) becomes the column
+    name. No `webdataset` dependency — the layout is plain tar. With
+    ``decode=True`` the conventional text-ish suffixes are decoded
+    (json -> object, txt -> str, cls -> int); images and everything else
+    stay raw bytes for a downstream `map_batches` to decode. ``suffixes``
+    keeps only the listed columns (plus __key__)."""
+    import tarfile
+
+    files = _resolve_paths(paths)
+
+    def _decode(suffix: str, data: bytes):
+        if not decode:
+            return data
+        if suffix == "json" or suffix.endswith(".json"):
+            import json as _json
+
+            return _json.loads(data)
+        if suffix in ("txt", "text"):
+            return data.decode("utf-8")
+        if suffix in ("cls", "cls2", "index", "id"):
+            return int(data.decode("utf-8").strip())
+        return data
+
+    def read(path) -> pa.Table:
+        rows = []
+        current_key, current = None, {}
+        with tarfile.open(path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                name = member.name.split("/")[-1]
+                if name.startswith("."):
+                    continue
+                key, dot, suffix = name.partition(".")
+                if not dot:
+                    continue
+                if key != current_key:
+                    if current:
+                        rows.append(current)
+                    current_key, current = key, {"__key__": key}
+                # a write-side dict/list column lands as "<col>.json" —
+                # restore the original column name after decoding
+                col = suffix[:-5] if suffix.endswith(".json") else suffix
+                if suffixes is not None and col not in suffixes:
+                    continue
+                current[col] = _decode(suffix, tf.extractfile(member).read())
+        if current:
+            rows.append(current)
+        return pa.Table.from_pylist(rows) if rows else pa.table({})
+
+    return Dataset([_Read(files, read)])
+
+
+def _mongo_client(uri: str, client_factory, op: str):
+    """The one place the pymongo-or-factory decision lives (read + write
+    paths must construct clients identically)."""
+    if client_factory is not None:
+        return client_factory()
+    try:
+        import pymongo
+    except ImportError as e:
+        raise ImportError(
+            f"{op} needs the pymongo package (not in this image) or an "
+            "explicit client_factory") from e
+    return pymongo.MongoClient(uri)
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[list] = None,
+               client_factory=None) -> Dataset:
+    """Documents from a MongoDB collection, optionally through an
+    aggregation pipeline (reference: read_api.py read_mongo /
+    mongo_datasource.py, which shards by partitioning _id ranges — here
+    one read task per pipeline; shard by unioning range-filtered calls).
+    ``client_factory`` (a zero-arg callable returning a pymongo-shaped
+    client) makes this testable without a server; it defaults to
+    ``pymongo.MongoClient(uri)`` and fails fast when pymongo is absent."""
+
+    def read(_src) -> pa.Table:
+        client = _mongo_client(uri, client_factory, "read_mongo")
+        try:
+            coll = client[database][collection]
+            docs = list(coll.aggregate(pipeline) if pipeline
+                        else coll.find({}))
+        finally:
+            client.close()
+        for d in docs:
+            d.pop("_id", None)  # ObjectId is not arrow-convertible
+        return pa.Table.from_pylist(docs) if docs else pa.table({})
+
+    return Dataset([_Read([f"{database}.{collection}"], read)])
+
+
 def read_binary_files(paths, *, include_paths: bool = False,
                       parallelism: int = -1) -> Dataset:
     """One row per file with its raw bytes (reference:

@@ -279,13 +279,18 @@ def _flash_forward(
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # blocks, see specs
     dk_ref, dv_ref,                                   # [g, block_kv, D]
-    dk_scr, dv_scr,                                   # VMEM [g, block_kv, D] f32
-    *,
+    *rest,  # fused mode: dq_ref [g, 1, block_q, D] f32; then scratch x2
     causal: bool,
     block_q: int,
     block_kv: int,
+    fused_dq: bool = False,
 ):
     from jax.experimental import pallas as pl
+
+    if fused_dq:
+        dq_ref, dk_scr, dv_scr = rest
+    else:
+        dq_ref, (dk_scr, dv_scr) = None, rest
 
     kv_idx = pl.program_id(1)
     q_idx = pl.program_id(2)
@@ -325,9 +330,21 @@ def _flash_bwd_dkv_kernel(
         dk_scr[:] = dk_scr[:] + _bdot(
             ds.astype(q.dtype), q, ((1,), (1,))
         )
+        if dq_ref is not None:
+            # fused single-sweep: the score block and dp are already in
+            # VMEM, so the dq contribution of THIS kv block costs one
+            # extra matmul — eliminating the entire second recompute pass
+            # (3 of 7 matmul sweeps + its exp2/mask/DMA traffic)
+            dq_ref[:, 0] = _bdot(ds.astype(k.dtype), k, ((2,), (1,)))
 
     if causal:
         executed, fully_below = _causal_regimes(q_idx, kv_idx, block_q, block_kv)
+
+        if dq_ref is not None:
+            # skipped blocks must still define their dq partial slot
+            @pl.when(jnp.logical_not(executed))
+            def _zero_dq():
+                dq_ref[:, 0] = jnp.zeros_like(dq_ref[:, 0])
 
         @pl.when(executed & jnp.logical_not(fully_below))
         def _():
@@ -447,21 +464,38 @@ def _flash_backward(
         pl.BlockSpec((g, block_q, 128), lambda b, j, i: (b, i, 0)),
         pl.BlockSpec((g, block_q, 128), lambda b, j, i: (b, i, 0)),
     ]
-    dk, dv = pl.pallas_call(
+    n_kv = seq_len // block_kv
+    # Fused single sweep when the kv-block count is small: the dk/dv pass
+    # already has the score block, dp, and k in VMEM, so each grid step
+    # emits its dq partial (one extra matmul) into a per-kv-block slot and
+    # XLA sums the n_kv slots — the entire dq recompute pass (3 of 7
+    # matmul sweeps + its exp2/mask/DMA) disappears. Partials cost
+    # bh*n_kv*S*hd f32 of HBM, so long sequences fall back to two-pass.
+    fused = n_kv <= 4
+    dkv_out_specs = [
+        pl.BlockSpec((g, block_kv, head_dim), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((g, block_kv, head_dim), lambda b, j, i: (b, j, 0)),
+    ]
+    dkv_out_shapes = [
+        jax.ShapeDtypeStruct((bh, seq_len, head_dim), k.dtype),
+        jax.ShapeDtypeStruct((bh, seq_len, head_dim), v.dtype),
+    ]
+    if fused:
+        dkv_out_specs.append(pl.BlockSpec(
+            (g, 1, block_q, head_dim), lambda b, j, i: (b, j, i, 0)
+        ))
+        dkv_out_shapes.append(jax.ShapeDtypeStruct(
+            (bh, n_kv, seq_len, head_dim), jnp.float32
+        ))
+    result = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, causal=causal,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, fused_dq=fused,
         ),
-        grid=(bh // g, seq_len // block_kv, seq_len // block_q),
+        grid=(bh // g, n_kv, seq_len // block_q),
         in_specs=dkv_specs,
-        out_specs=[
-            pl.BlockSpec((g, block_kv, head_dim), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((g, block_kv, head_dim), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, seq_len, head_dim), k.dtype),
-            jax.ShapeDtypeStruct((bh, seq_len, head_dim), v.dtype),
-        ],
+        out_specs=dkv_out_specs,
+        out_shape=dkv_out_shapes,
         scratch_shapes=[
             pltpu.VMEM((g, block_kv, head_dim), jnp.float32),
             pltpu.VMEM((g, block_kv, head_dim), jnp.float32),
@@ -472,6 +506,14 @@ def _flash_backward(
         ),
         interpret=interpret,
     )(qf, kf, vf, dof, lse_b, delta_b)
+
+    shape = (batch, heads, seq_len, head_dim)
+    if fused:
+        dk, dv, dq_parts = result
+        dq = jnp.sum(dq_parts, axis=1).astype(q.dtype)
+        dq = (dq * jnp.asarray(scale2, dq.dtype)).reshape(shape)
+        return dq, dk.reshape(shape), dv.reshape(shape)
+    dk, dv = result
 
     # pass 2: dq — q blocks outer, kv inner
     row_specs = [
@@ -501,7 +543,6 @@ def _flash_backward(
         interpret=interpret,
     )(qf, kf, vf, dof, lse_b, delta_b)
 
-    shape = (batch, heads, seq_len, head_dim)
     dq = (dq * jnp.asarray(scale2, dq.dtype)).reshape(shape)
     return dq, dk.reshape(shape), dv.reshape(shape)
 

@@ -93,7 +93,18 @@ def _fused_fwd_impl(x, w, targets, mask, chunk):
         return (loss_sum, cnt, dw), dxc
 
     init = (jnp.float32(0.0), jnp.float32(0.0), jnp.zeros((v, d), jnp.float32))
-    (loss_sum, cnt, dw), dxs = jax.lax.scan(body, init, (xs, ts, ms))
+    if 0 < n_chunks <= 8:
+        # unrolled chunk loop: XLA overlaps/schedules the per-chunk matmul
+        # triplets across chunk boundaries instead of paying the scan-carry
+        # tax (measured 36 -> 27 ms at 16k tokens on v5e — same reason the
+        # GPT layer stack unrolls, see models/gpt.py scan_layers)
+        carry, dx_list = init, []
+        for i in range(n_chunks):
+            carry, dxc = body(carry, (xs[i], ts[i], ms[i]))
+            dx_list.append(dxc)
+        (loss_sum, cnt, dw), dxs = carry, jnp.stack(dx_list)
+    else:
+        (loss_sum, cnt, dw), dxs = jax.lax.scan(body, init, (xs, ts, ms))
     cnt = jnp.maximum(cnt, 1.0)
     dx = dxs.reshape(n_chunks * chunk, d)[:n]
     return loss_sum / cnt, (dx / cnt, dw / cnt)

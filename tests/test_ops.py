@@ -22,16 +22,19 @@ def test_flash_attention_matches_reference(jax_cpu):
     assert float(jnp.max(jnp.abs(ref - out))) < 2e-5
 
 
-def test_flash_attention_grads(jax_cpu):
+@pytest.mark.parametrize("seq,block", [(128, 64), (256, 32)])
+def test_flash_attention_grads(jax_cpu, seq, block):
+    """(128, 64) -> 2 kv blocks: fused single-sweep backward;
+    (256, 32) -> 8 kv blocks: two-pass backward. Both must match XLA."""
     import jax, jax.numpy as jnp
     from ray_tpu.ops.attention import flash_attention, mha_reference
 
     key = jax.random.PRNGKey(1)
-    B, H, S, D = 1, 2, 128, 32
+    B, H, S, D = 1, 2, seq, 32
     q, k, v = (
         jax.random.normal(jax.random.fold_in(key, i), (B, H, S, D)) for i in range(3)
     )
-    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, block_q=64, block_kv=64) ** 2),
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, block_q=block, block_kv=block) ** 2),
                   argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(lambda *a: jnp.sum(mha_reference(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
