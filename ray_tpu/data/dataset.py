@@ -671,12 +671,17 @@ class Dataset:
         .json members (suffix forced if the column isn't named json)."""
         import io
         import json as _json
+        import os
         import tarfile
 
         def w(t, p):
+            # fallback keys are shard-qualified ("part-00001-000042"): the
+            # per-block row index alone would collide across shards, and
+            # __key__ is WebDataset's sample identity under concatenation
+            shard = os.path.splitext(os.path.basename(p))[0]
             with tarfile.open(p, "w") as tf:
                 for i, row in enumerate(t.to_pylist()):
-                    key = str(row.pop("__key__", i))
+                    key = str(row.pop("__key__", f"{shard}-{i:06d}"))
                     for col, val in row.items():
                         if val is None:
                             continue
@@ -684,7 +689,7 @@ class Dataset:
                             data = val
                         elif isinstance(val, (dict, list)):
                             data = _json.dumps(val).encode()
-                            if col != "json" and not col.endswith("json"):
+                            if col != "json" and not col.endswith(".json"):
                                 col = col + ".json"
                         else:
                             data = str(val).encode()

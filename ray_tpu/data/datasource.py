@@ -382,8 +382,12 @@ def read_mongo(uri: str, database: str, collection: str, *,
                         else coll.find({}))
         finally:
             client.close()
+        arrow_ok = (str, int, float, bool, list, dict, bytes, type(None))
         for d in docs:
-            d.pop("_id", None)  # ObjectId is not arrow-convertible
+            # drop only non-arrow-convertible _id values (pymongo ObjectId);
+            # a $group pipeline's _id IS the group key and must survive
+            if "_id" in d and not isinstance(d["_id"], arrow_ok):
+                del d["_id"]
         return pa.Table.from_pylist(docs) if docs else pa.table({})
 
     return Dataset([_Read([f"{database}.{collection}"], read)])

@@ -58,3 +58,7 @@ from ray_tpu.rllib.algorithms.slateq import (
 )
 
 __all__ += ["RecSysEnv", "SlateQ", "SlateQConfig"]
+
+from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
+
+__all__ += ["ARS", "ARSConfig"]

@@ -11,6 +11,7 @@ from ray_tpu.rllib.offline.io import (
     compute_returns,
 )
 from ray_tpu.rllib.offline.cql import CQL, CQLConfig
+from ray_tpu.rllib.offline.crr import CRR, CRRConfig
 from ray_tpu.rllib.offline.dt import DT, DTConfig
 from ray_tpu.rllib.offline.marwil import BC, BCConfig, MARWIL, MARWILConfig
 
@@ -19,6 +20,8 @@ __all__ = [
     "BCConfig",
     "CQL",
     "CQLConfig",
+    "CRR",
+    "CRRConfig",
     "DT",
     "DTConfig",
     "DatasetReader",

@@ -1,0 +1,215 @@
+"""CRR — Critic Regularized Regression from offline experience files.
+
+Equivalent of the reference's CRR (reference: rllib/algorithms/crr/crr.py —
+Wang et al. 2020). Discrete-action variant: a single-Q critic trains by
+expected-SARSA TD against a target-network copy (the reference uses twin
+critics; with the full discrete action set enumerable, the expectation
+backup already tempers the max-operator overestimation twin critics exist
+to fight); the policy trains by advantage-weighted behavior cloning where
+the weight is
+
+    f(A) = 1[A > 0]            (mode="binary", the paper's robust default)
+    f(A) = clip(exp(A / beta)) (mode="exp")
+
+with A(s, a) = Q(s, a) - E_{a'~pi} Q(s, a') estimated from the critic and
+the CURRENT policy's distribution. Unlike BC the policy only imitates
+dataset actions the critic judges better than the policy's average — the
+filtering is what lets CRR improve on mixed-quality data where BC merely
+averages it. Reads the same JsonReader/DatasetReader experience format as
+MARWIL/BC/CQL.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.offline.io import DatasetReader, JsonReader
+from ray_tpu.rllib.rl_module import ActorCriticModule, QModule
+
+
+def crr_critic_loss(module, params, batch, config):
+    """TD against the target net, successor action from the CURRENT
+    policy's distribution (expected SARSA backup — matches the actor being
+    regularized toward the data, pure jax)."""
+    import jax
+    import jax.numpy as jnp
+
+    q = module.forward(params, batch["obs"])
+    q_data = jnp.take_along_axis(q, batch["actions"][:, None], axis=-1)[:, 0]
+    q_next = module.forward(batch["target_params"], batch["next_obs"])
+    pi_next = jax.nn.softmax(batch["next_logits"])
+    v_next = jnp.sum(pi_next * q_next, axis=-1)
+    not_term = 1.0 - batch["terminateds"].astype(q.dtype)
+    target = batch["rewards"] + config["gamma"] * not_term * v_next
+    td_loss = jnp.mean(jnp.square(q_data - jax.lax.stop_gradient(target)))
+    return td_loss, {"td_loss": td_loss, "q_data_mean": jnp.mean(q_data)}
+
+
+def crr_actor_loss(module, params, batch, config):
+    """-logp(a|s) * f(A) with A from the frozen critic (pure jax)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits, _ = module.forward(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+    q = batch["q_values"]                      # [B, A] from the critic
+    pi = jax.nn.softmax(jax.lax.stop_gradient(logits))
+    v = jnp.sum(pi * q, axis=-1)
+    adv = jnp.take_along_axis(q, batch["actions"][:, None], axis=-1)[:, 0] - v
+    if config["mode"] == "binary":
+        weight = (adv > 0).astype(logp.dtype)
+    else:
+        weight = jnp.clip(jnp.exp(adv / config["beta"]), 0.0,
+                          config["weight_clip"])
+    actor_loss = -jnp.mean(jax.lax.stop_gradient(weight) * logp)
+    return actor_loss, {
+        "actor_loss": actor_loss,
+        "mean_weight": jnp.mean(weight),
+        "adv_mean": jnp.mean(adv),
+    }
+
+
+class CRRConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.mode = "binary"          # binary | exp
+        self.beta = 1.0               # exp-mode temperature
+        self.weight_clip = 20.0
+        self.input_ = None
+        self.observation_dim = None
+        self.num_actions = None
+        self.target_update_freq = 50  # critic gradient steps
+        self.algo_class = CRR
+
+    def offline_data(self, input_=None, mode=None, beta=None) -> "CRRConfig":
+        if input_ is not None:
+            self.input_ = input_
+        if mode is not None:
+            self.mode = mode
+        if beta is not None:
+            self.beta = beta
+        return self
+
+    def environment(self, env=None, *, observation_dim=None,
+                    num_actions=None) -> "CRRConfig":
+        if env is not None:
+            self.env_spec = env
+        if observation_dim is not None:
+            self.observation_dim = observation_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        return self
+
+
+class CRR(Algorithm):
+    """Offline-only: transitions from experience files; each training_step
+    interleaves critic TD epochs with advantage-filtered policy epochs."""
+
+    def _setup(self) -> None:
+        cfg = self.config
+        reader = cfg.input_
+        if isinstance(reader, str):
+            reader = JsonReader(reader)
+        elif reader is not None and not hasattr(reader, "episodes"):
+            reader = DatasetReader(reader)
+        if reader is None:
+            raise ValueError("CRR requires config.offline_data(input_=...)")
+        obs, actions, rewards, next_obs, term = [], [], [], [], []
+        for ep in reader.episodes():
+            for i, row in enumerate(ep):
+                terminated = bool(row.get("terminated", row["done"]))
+                if i + 1 == len(ep) and not terminated:
+                    continue  # truncated tail: no successor, don't bootstrap
+                obs.append(row["obs"])
+                actions.append(row["action"])
+                rewards.append(row["reward"])
+                next_obs.append(ep[i + 1]["obs"] if i + 1 < len(ep)
+                                else row["obs"])
+                term.append(terminated)
+        if not actions:
+            raise ValueError("offline input is empty")
+        self._obs = np.asarray(obs, np.float32)
+        self._actions = np.asarray(actions)
+        if self._actions.ndim != 1 or not np.all(
+                self._actions == np.round(self._actions)):
+            raise ValueError(
+                "discrete CRR requires scalar integer actions; got shape "
+                f"{self._actions.shape}")
+        self._actions = self._actions.astype(np.int32)
+        self._rewards = np.asarray(rewards, np.float32)
+        self._next_obs = np.asarray(next_obs, np.float32)
+        self._terminateds = np.asarray(term, np.bool_)
+        self.obs_dim = cfg.observation_dim or int(self._obs.shape[1])
+        self.num_actions = cfg.num_actions or int(self._actions.max()) + 1
+        self._rng = np.random.default_rng(cfg.seed)
+        self._build_learner()
+
+    def _build_learner(self) -> None:
+        cfg = self.config
+        self.critic = Learner(
+            QModule(self.obs_dim, self.num_actions, cfg.hidden),
+            crr_critic_loss,
+            config={"gamma": cfg.gamma},
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self.learner = Learner(  # the policy (named learner for checkpoints)
+            ActorCriticModule(self.obs_dim, self.num_actions, cfg.hidden),
+            crr_actor_loss,
+            config={"mode": cfg.mode, "beta": cfg.beta,
+                    "weight_clip": cfg.weight_clip},
+            learning_rate=cfg.lr,
+            max_grad_norm=cfg.max_grad_norm,
+            mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+        self._target_params = self.critic.get_weights_np()
+        self._grad_steps = 0
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n = len(self._actions)
+        mb = min(cfg.minibatch_size, n)
+        metrics_acc: dict[str, list[float]] = {}
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start:start + mb]
+                pw = self.learner.get_weights_np()
+                next_logits, _ = self.learner.module.forward_np(
+                    pw, self._next_obs[idx])
+                m = self.critic.update({
+                    "obs": self._obs[idx],
+                    "actions": self._actions[idx],
+                    "rewards": self._rewards[idx],
+                    "next_obs": self._next_obs[idx],
+                    "terminateds": self._terminateds[idx],
+                    "next_logits": np.asarray(next_logits, np.float32),
+                    "target_params": self._target_params,
+                })
+                self._grad_steps += 1
+                if self._grad_steps % cfg.target_update_freq == 0:
+                    self._target_params = self.critic.get_weights_np()
+                cw = self.critic.get_weights_np()
+                q_values = self.critic.module.forward_np(cw, self._obs[idx])
+                ma = self.learner.update({
+                    "obs": self._obs[idx],
+                    "actions": self._actions[idx],
+                    "q_values": np.asarray(q_values, np.float32),
+                })
+                for k, v in {**m, **ma}.items():
+                    metrics_acc.setdefault(k, []).append(v)
+        return {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+
+    def _sample_all(self):  # pragma: no cover — offline only
+        raise RuntimeError("offline algorithm does not sample")
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        w = self.learner.get_weights_np()
+        logits, _ = self.learner.module.forward_np(
+            w, np.asarray(obs, np.float32)[None])
+        return int(np.argmax(logits[0]))

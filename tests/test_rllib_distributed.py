@@ -148,3 +148,31 @@ def test_es_improves_corridor(jax_cpu, ray_start):
         assert best >= 0.6, best
     finally:
         algo.stop()
+
+
+def test_ars_improves_corridor(jax_cpu, ray_start):
+    """ARS (top-k direction selection + sigma_R step normalization +
+    observation filter) learns the corridor like ES but with the
+    augmented update (reference: rllib_contrib/ars)."""
+    from ray_tpu.rllib.algorithms import ARSConfig
+
+    cfg = (
+        ARSConfig()
+        .environment("Corridor")
+        .training(num_workers=2, num_directions=16, num_top_directions=8,
+                  sigma=0.1, ars_lr=0.1, episode_limit=50)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    try:
+        best = algo.train()["episode_return_mean"]
+        for _ in range(14):
+            m = algo.train()
+            best = max(best, m["episode_return_mean"])
+            if best >= 0.6:
+                break
+        assert best >= 0.6, best
+        # the merged observation filter saw every rollout step
+        assert m["filter_count"] > 0
+    finally:
+        algo.stop()
