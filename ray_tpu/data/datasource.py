@@ -382,10 +382,15 @@ def read_mongo(uri: str, database: str, collection: str, *,
                         else coll.find({}))
         finally:
             client.close()
-        arrow_ok = (str, int, float, bool, list, dict, bytes, type(None))
+        import datetime
+        import decimal
+
+        arrow_ok = (str, int, float, bool, list, dict, bytes, type(None),
+                    datetime.datetime, datetime.date, decimal.Decimal)
         for d in docs:
             # drop only non-arrow-convertible _id values (pymongo ObjectId);
-            # a $group pipeline's _id IS the group key and must survive
+            # a $group pipeline's _id IS the group key and must survive —
+            # including date/Decimal group keys, which arrow handles
             if "_id" in d and not isinstance(d["_id"], arrow_ok):
                 del d["_id"]
         return pa.Table.from_pylist(docs) if docs else pa.table({})

@@ -39,6 +39,12 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     attention: str = "flash"  # flash | xla | ring
     remat: bool = False
+    scan_layers: bool = True  # lax.scan over blocks vs unrolled loop (see
+                              # models/gpt.py: unrolling dodges the
+                              # backward's scan-carry tax; benches unroll,
+                              # pipeline meshes keep the scan)
+    fused_loss: bool = True   # chunked lm-head+CE on the single-device
+                              # path — no [B,S,V] logits (ops/loss.py)
     # MoE (0 = dense SwiGLU)
     num_experts: int = 0
     top_k: int = 2
@@ -214,16 +220,16 @@ def _block(x, bp, cos, sin, cfg: LlamaConfig, rules, mesh):
     return constrain(x, ("batch", "seq", "embed")), aux
 
 
-def llama_forward(
+def llama_hidden(
     params: dict,
     tokens: jax.Array,
     cfg: LlamaConfig,
     *,
     rules: ShardingRules | None = None,
     mesh=None,
-    return_aux: bool = False,
 ):
-    """tokens [B, S] int32 → logits [B, S, vocab] f32 (+ total MoE aux loss)."""
+    """tokens [B, S] int32 → (final hidden [B, S, D] after rms_norm,
+    summed MoE aux loss)."""
     B, S = tokens.shape
     wte = params["wte"].astype(cfg.dtype)
     if mesh is not None:
@@ -243,11 +249,36 @@ def llama_forward(
 
     if cfg.remat:
         body = jax.checkpoint(body)
-    (x, aux_sum), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+    init = (x, jnp.zeros((), jnp.float32))
+    if cfg.scan_layers:
+        (x, aux_sum), _ = jax.lax.scan(body, init, params["blocks"])
+    else:
+        carry = init
+        for i in range(cfg.n_layer):
+            carry, _ = body(carry, jax.tree.map(lambda a: a[i],
+                                                params["blocks"]))
+        x, aux_sum = carry
+    return rms_norm(x, params["ln_f_scale"]), aux_sum
+
+
+def llama_forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    rules: ShardingRules | None = None,
+    mesh=None,
+    return_aux: bool = False,
+):
+    """tokens [B, S] int32 → logits [B, S, vocab] f32 (+ total MoE aux loss)."""
+    x, aux_sum = llama_hidden(params, tokens, cfg, rules=rules, mesh=mesh)
+    # bf16 operands keep the vocab matmul on the MXU's fast path;
+    # accumulation and the returned logits are f32 for a stable softmax
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x.astype(cfg.dtype),
+        params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
     )
-    x = rms_norm(x, params["ln_f_scale"])
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
     if return_aux:
         return logits, aux_sum
     return logits
@@ -271,6 +302,21 @@ def llama_loss(
             mask = mask[:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
+    if cfg.fused_loss and mesh is None:
+        # single-device path: chunked lm-head + CE (ops/loss.py) — the
+        # [B,S,V] logits tensor never exists. lm_head is [D, V]; the
+        # transpose folds into the chunk matmuls' dimension numbers.
+        from ray_tpu.ops.loss import fused_lm_head_loss
+
+        x, aux = llama_hidden(params, inputs, cfg, rules=rules, mesh=mesh)
+        B, S, D = x.shape
+        ce = fused_lm_head_loss(
+            x.reshape(B * S, D),
+            params["lm_head"].T,
+            targets.reshape(B * S).astype(jnp.int32),
+            None if mask is None else mask.reshape(B * S).astype(jnp.float32),
+        )
+        return ce + aux
     logits, aux = llama_forward(
         params, inputs, cfg, rules=rules, mesh=mesh, return_aux=True
     )

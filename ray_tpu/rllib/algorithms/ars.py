@@ -1,22 +1,21 @@
 """ARS — Augmented Random Search (Mania et al. 2018).
 
-Equivalent of the reference's ARS (reference: rllib_contrib/ars/src/..../
-ars.py — the V2 variant: antithetic perturbation rollouts like ES, plus
-the three augmentations that define ARS: (1) only the top-k directions by
-max(r+, r-) contribute to the update, (2) the step is normalized by the
-standard deviation of the selected returns, (3) observations are
-normalized by a running mean/std filter synchronized across workers each
-iteration). Shares the ES worker geometry: only integer noise seeds and
-the filter's summary statistics cross the wire.
+Equivalent of the reference's ARS (reference: rllib_contrib/ars — the V2
+variant). Extends ES (same antithetic-perturbation worker geometry, only
+integer noise seeds cross the wire) with the three augmentations that
+define ARS: (1) only the top-k directions by max(r+, r-) contribute to
+the update, (2) the step is normalized by the standard deviation of the
+selected returns, (3) observations are normalized by a running mean/std
+filter whose per-worker statistics are Welford-merged on the driver and
+re-broadcast each iteration.
 """
 from __future__ import annotations
 
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.algorithms.es import _flatten, _unflatten
-from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.algorithms.es import ES, ESWorker, _unflatten
 from ray_tpu.rllib.rl_module import ActorCriticModule
 
 
@@ -29,11 +28,11 @@ class _RunningStat:
         self.m2 = np.zeros(dim, np.float64)
 
     def push_batch(self, xs: np.ndarray) -> None:
-        for x in np.asarray(xs, np.float64):
-            self.count += 1.0
-            delta = x - self.mean
-            self.mean += delta / self.count
-            self.m2 += delta * (x - self.mean)
+        xs = np.asarray(xs, np.float64)
+        if len(xs) == 0:
+            return
+        mean = xs.mean(axis=0)
+        self.merge(float(len(xs)), mean, ((xs - mean) ** 2).sum(axis=0))
 
     def merge(self, count, mean, m2) -> None:
         if count <= 0:
@@ -51,40 +50,31 @@ class _RunningStat:
         return np.sqrt(np.maximum(self.m2 / (self.count - 1), 1e-8))
 
 
-class ARSWorker:
-    """Antithetic-rollout actor with a local observation filter; returns
-    per-seed (r+, r-) pairs plus the filter's batch statistics so the
-    driver can merge and re-broadcast a consistent normalization."""
+class ARSWorker(ESWorker):
+    """ESWorker + observation normalization: rollouts normalize with the
+    driver-broadcast filter and return their own batch statistics."""
 
-    def __init__(self, env_spec, hidden, sigma: float, seed: int,
-                 episode_limit: int = 500):
-        self.env = make_env(env_spec)
-        obs0 = self.env.reset(seed=seed)
-        self.obs_dim = int(np.asarray(obs0).shape[0])
-        self.num_actions = int(getattr(self.env, "num_actions", 2))
-        self.module = ActorCriticModule(self.obs_dim, self.num_actions,
-                                        tuple(hidden))
-        self.sigma = sigma
-        self.episode_limit = episode_limit
-
-    def _episode_return(self, theta, spec, seed, mean, std, stat):
+    def _episode_return(self, theta, spec, seed, mean=None, std=None,
+                        seen=None):
+        if mean is None:
+            return super()._episode_return(theta, spec, seed)
         params = _unflatten(theta, spec)
         obs = self.env.reset(seed=seed)
         total = 0.0
         for _ in range(self.episode_limit):
             o = np.asarray(obs, np.float32)
-            stat.append(o)
+            seen.append(o)
             norm = (o - mean) / std
             logits = ActorCriticModule._mlp_np(params["policy"], norm[None])
-            action = int(np.argmax(logits[0]))
-            obs, r, term, trunc = self.env.step(action)
+            obs, r, term, trunc = self.env.step(int(np.argmax(logits[0])))
             total += float(r)
             if term or trunc:
                 break
         return total
 
-    def evaluate(self, theta: np.ndarray, spec, seeds: list, eval_seed: int,
-                 mean: np.ndarray, std: np.ndarray):
+    def evaluate(self, theta, spec, seeds, eval_seed, mean=None, std=None):
+        if mean is None:  # ES-compatible call shape
+            return super().evaluate(theta, spec, seeds, eval_seed)
         pairs, seen = [], []
         for s in seeds:
             noise = np.random.default_rng(s).standard_normal(
@@ -96,8 +86,8 @@ class ARSWorker:
                                      eval_seed, mean, std, seen),
             ))
         stat = _RunningStat(self.obs_dim)
-        if seen:
-            stat.push_batch(np.asarray(seen, np.float64))
+        stat.push_batch(np.asarray(seen, np.float64) if seen
+                        else np.zeros((0, self.obs_dim)))
         return pairs, (stat.count, stat.mean, stat.m2)
 
 
@@ -113,48 +103,33 @@ class ARSConfig(AlgorithmConfig):
         self.algo_class = ARS
 
 
-class ARS(Algorithm):
-    """Driver holds theta + the merged observation filter."""
+class ARS(ES):
+    """ES driver with the augmented update + merged observation filter.
+    _setup/stop/train are inherited; only the worker class, the filter,
+    and the update rule differ."""
+
+    _worker_cls = ARSWorker
 
     def _setup(self) -> None:
-        cfg = self.config
-        env = make_env(cfg.env_spec)
-        obs0 = env.reset(seed=cfg.seed or 0)
-        obs_dim = int(np.asarray(obs0).shape[0])
-        num_actions = int(getattr(env, "num_actions", 2))
-        env.close()
-        self.module = ActorCriticModule(obs_dim, num_actions,
-                                        tuple(cfg.hidden))
-        p = self.module.init(cfg.seed or 0)
-        self.theta, self._spec = _flatten({"policy": p["pi"]})
-        self._filter = _RunningStat(obs_dim)
-        Worker = ray_tpu.remote(num_cpus=1)(ARSWorker)
-        self._workers = [
-            Worker.remote(cfg.env_spec, tuple(cfg.hidden), cfg.sigma,
-                          (cfg.seed or 0) + i, cfg.episode_limit)
-            for i in range(cfg.num_workers)
-        ]
-        self._rng = np.random.default_rng(cfg.seed or 0)
-        self._iter = 0
-
-    def _build_learner(self) -> None:  # pragma: no cover — gradient-free
-        pass
+        super()._setup()
+        self._filter = _RunningStat(self.obs_dim)
 
     def training_step(self) -> dict:
         cfg = self.config
         self._iter += 1
         seeds = self._rng.integers(0, 2**31, cfg.num_directions)
-        chunks = np.array_split(seeds, len(self._workers))
+        chunks = [c for c in np.array_split(seeds, len(self._workers))
+                  if len(c)]
         eval_seed = int(self._rng.integers(0, 2**31))
         mean = self._filter.mean.astype(np.float32)
         std = self._filter.std.astype(np.float32)
         refs = [
             w.evaluate.remote(self.theta, self._spec, [int(s) for s in c],
                               eval_seed, mean, std)
-            for w, c in zip(self._workers, chunks) if len(c)
+            for w, c in zip(self._workers, chunks)
         ]
         pairs, used_seeds = [], []
-        for r, c in zip(refs, [c for c in chunks if len(c)]):
+        for r, c in zip(refs, chunks):
             p, (cnt, m, m2) = ray_tpu.get(r, timeout=300)
             pairs.extend(p)
             used_seeds.extend(int(s) for s in c[: len(p)])
@@ -184,18 +159,3 @@ class ARS(Algorithm):
                 / self._filter.std).astype(np.float32)
         logits = ActorCriticModule._mlp_np(params["policy"], norm[None])
         return int(np.argmax(logits[0]))
-
-    def stop(self) -> None:
-        for w in getattr(self, "_workers", ()):
-            try:
-                ray_tpu.kill(w)
-            except Exception:
-                pass
-        super().stop()
-
-    def train(self) -> dict:
-        # ES-family: owns its return metrics (no EnvRunner tracker)
-        metrics = self.training_step()
-        self.iteration += 1
-        metrics["training_iteration"] = self.iteration
-        return metrics

@@ -102,7 +102,10 @@ class ESConfig(AlgorithmConfig):
 
 
 class ES(Algorithm):
-    """Driver holds theta; workers evaluate perturbations in parallel."""
+    """Driver holds theta; workers evaluate perturbations in parallel.
+    Subclasses (ARS) swap `_worker_cls` and the update rule."""
+
+    _worker_cls = ESWorker
 
     def _setup(self) -> None:
         cfg = self.config
@@ -111,11 +114,12 @@ class ES(Algorithm):
         obs_dim = int(np.asarray(obs0).shape[0])
         num_actions = int(getattr(env, "num_actions", 2))
         env.close()
+        self.obs_dim = obs_dim
         self.module = ActorCriticModule(obs_dim, num_actions,
                                         tuple(cfg.hidden))
         p = self.module.init(cfg.seed or 0)
         self.theta, self._spec = _flatten({"policy": p["pi"]})
-        Worker = ray_tpu.remote(num_cpus=1)(ESWorker)
+        Worker = ray_tpu.remote(num_cpus=1)(type(self)._worker_cls)
         self._workers = [
             Worker.remote(cfg.env_spec, tuple(cfg.hidden), cfg.sigma,
                           (cfg.seed or 0) + i, cfg.episode_limit)

@@ -31,14 +31,18 @@ from ray_tpu.rllib.rl_module import ActorCriticModule, QModule
 def crr_critic_loss(module, params, batch, config):
     """TD against the target net, successor action from the CURRENT
     policy's distribution (expected SARSA backup — matches the actor being
-    regularized toward the data, pure jax)."""
+    regularized toward the data). The policy's params ride in the batch
+    (replicated pytree, the DQN target_params pattern) so the whole step
+    stays inside this jit — no host-side forward per minibatch."""
     import jax
     import jax.numpy as jnp
 
     q = module.forward(params, batch["obs"])
     q_data = jnp.take_along_axis(q, batch["actions"][:, None], axis=-1)[:, 0]
     q_next = module.forward(batch["target_params"], batch["next_obs"])
-    pi_next = jax.nn.softmax(batch["next_logits"])
+    next_logits, _ = config["policy_module"].forward(
+        batch["policy_params"], batch["next_obs"])
+    pi_next = jax.nn.softmax(jax.lax.stop_gradient(next_logits))
     v_next = jnp.sum(pi_next * q_next, axis=-1)
     not_term = 1.0 - batch["terminateds"].astype(q.dtype)
     target = batch["rewards"] + config["gamma"] * not_term * v_next
@@ -47,14 +51,16 @@ def crr_critic_loss(module, params, batch, config):
 
 
 def crr_actor_loss(module, params, batch, config):
-    """-logp(a|s) * f(A) with A from the frozen critic (pure jax)."""
+    """-logp(a|s) * f(A), advantages from the frozen critic whose params
+    ride in the batch (on-device, see crr_critic_loss)."""
     import jax
     import jax.numpy as jnp
 
     logits, _ = module.forward(params, batch["obs"])
     logp_all = jax.nn.log_softmax(logits)
     logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=-1)[:, 0]
-    q = batch["q_values"]                      # [B, A] from the critic
+    q = jax.lax.stop_gradient(
+        config["critic_module"].forward(batch["critic_params"], batch["obs"]))
     pi = jax.nn.softmax(jax.lax.stop_gradient(logits))
     v = jnp.sum(pi * q, axis=-1)
     adv = jnp.take_along_axis(q, batch["actions"][:, None], axis=-1)[:, 0] - v
@@ -148,20 +154,24 @@ class CRR(Algorithm):
 
     def _build_learner(self) -> None:
         cfg = self.config
+        critic_module = QModule(self.obs_dim, self.num_actions, cfg.hidden)
+        policy_module = ActorCriticModule(self.obs_dim, self.num_actions,
+                                          cfg.hidden)
         self.critic = Learner(
-            QModule(self.obs_dim, self.num_actions, cfg.hidden),
+            critic_module,
             crr_critic_loss,
-            config={"gamma": cfg.gamma},
+            config={"gamma": cfg.gamma, "policy_module": policy_module},
             learning_rate=cfg.lr,
             max_grad_norm=cfg.max_grad_norm,
             mesh=cfg.mesh,
             seed=cfg.seed,
         )
         self.learner = Learner(  # the policy (named learner for checkpoints)
-            ActorCriticModule(self.obs_dim, self.num_actions, cfg.hidden),
+            policy_module,
             crr_actor_loss,
             config={"mode": cfg.mode, "beta": cfg.beta,
-                    "weight_clip": cfg.weight_clip},
+                    "weight_clip": cfg.weight_clip,
+                    "critic_module": critic_module},
             learning_rate=cfg.lr,
             max_grad_norm=cfg.max_grad_norm,
             mesh=cfg.mesh,
@@ -179,31 +189,41 @@ class CRR(Algorithm):
             perm = self._rng.permutation(n)
             for start in range(0, n - mb + 1, mb):
                 idx = perm[start:start + mb]
-                pw = self.learner.get_weights_np()
-                next_logits, _ = self.learner.module.forward_np(
-                    pw, self._next_obs[idx])
+                # the other learner's live device params ride in the batch
+                # (replicated pytree) — no device→host copies on this loop
                 m = self.critic.update({
                     "obs": self._obs[idx],
                     "actions": self._actions[idx],
                     "rewards": self._rewards[idx],
                     "next_obs": self._next_obs[idx],
                     "terminateds": self._terminateds[idx],
-                    "next_logits": np.asarray(next_logits, np.float32),
+                    "policy_params": self.learner.params,
                     "target_params": self._target_params,
                 })
                 self._grad_steps += 1
                 if self._grad_steps % cfg.target_update_freq == 0:
                     self._target_params = self.critic.get_weights_np()
-                cw = self.critic.get_weights_np()
-                q_values = self.critic.module.forward_np(cw, self._obs[idx])
                 ma = self.learner.update({
                     "obs": self._obs[idx],
                     "actions": self._actions[idx],
-                    "q_values": np.asarray(q_values, np.float32),
+                    "critic_params": self.critic.params,
                 })
                 for k, v in {**m, **ma}.items():
                     metrics_acc.setdefault(k, []).append(v)
         return {k: float(np.mean(v)) for k, v in metrics_acc.items()}
+
+    # -- checkpointing: the first two-Learner algorithm — the base class
+    # persists self.learner (the policy); the critic must ride along or a
+    # restore would filter the actor loss with a random-critic advantage
+    def save_state(self) -> dict:
+        state = super().save_state()
+        state["critic"] = self.critic.state()
+        return state
+
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        if "critic" in state:
+            self.critic.load_state(state["critic"])
 
     def _sample_all(self):  # pragma: no cover — offline only
         raise RuntimeError("offline algorithm does not sample")

@@ -9,6 +9,7 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     HyperBandScheduler,
     MedianStoppingRule,
     PB2,
@@ -21,6 +22,7 @@ from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
     TPESearcher,
+    TuneBOHB,
     choice,
     grid_search,
     loguniform,
@@ -41,6 +43,7 @@ from ray_tpu.tune.tuner import (
 __all__ = [
     "SuggestAdapter",
     "ASHAScheduler",
+    "HyperBandForBOHB",
     "HyperBandScheduler",
     "PB2",
     "PopulationBasedTrainingReplay",
@@ -61,6 +64,7 @@ __all__ = [
     "get_checkpoint",
     "grid_search",
     "TPESearcher",
+    "TuneBOHB",
     "loguniform",
     "randint",
     "report",

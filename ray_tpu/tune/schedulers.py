@@ -333,6 +333,30 @@ class HyperBandScheduler(TrialScheduler):
         return out
 
 
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand variant that feeds every milestone observation to a
+    linked TuneBOHB searcher (reference: tune/schedulers/hb_bohb.py
+    HyperBandForBOHB). The scheduler side of BOHB is unchanged
+    synchronous successive halving; the coupling is that each trial's
+    score AT a budget barrier becomes a per-budget training point for the
+    searcher's TPE model, so later suggestions are model-based at the
+    highest fidelity that has enough evidence."""
+
+    def __init__(self, *args, searcher=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._searcher = searcher
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        tid = trial.trial_id
+        t = result.get(self.time_attr, trial.iteration)
+        if (self._searcher is not None and t >= self.milestone
+                and tid not in self._scores):
+            # first report at/after the current barrier: this is the score
+            # HyperBand will judge at budget=milestone — tell the model
+            self._searcher.on_budget_result(tid, self.milestone, result)
+        return super().on_trial_result(trial, result)
+
+
 class PB2(PopulationBasedTraining):
     """Population-Based Bandits (reference: tune/schedulers/pb2.py —
     PBT whose EXPLORE step replaces random perturbation with a GP-bandit

@@ -353,6 +353,48 @@ class TPESearcher(Searcher):
         self._obs.append((flat, score))
 
 
+class TuneBOHB(TPESearcher):
+    """BOHB's model-based half (Falkner et al. 2018; reference:
+    tune/search/bohb/bohb_search.py TuneBOHB). A TPE model fit
+    PER BUDGET: milestone results reported by HyperBandForBOHB land in
+    per-budget observation pools, and suggestions are drawn from the model
+    of the LARGEST budget that has at least n_startup observations —
+    BOHB's defining rule, so early low-budget evidence guides the search
+    immediately but is superseded by higher-fidelity evidence as brackets
+    deepen. Pair with schedulers.HyperBandForBOHB, which feeds
+    on_budget_result at every milestone barrier."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._budget_obs: dict[float, list[tuple[dict, float]]] = {}
+
+    def on_budget_result(self, trial_id: str, budget: float,
+                         result: dict) -> None:
+        flat = self._live.get(trial_id)
+        if flat is None or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._budget_obs.setdefault(float(budget), []).append((flat, score))
+
+    def _select_pool(self) -> list[tuple[dict, float]]:
+        for budget in sorted(self._budget_obs, reverse=True):
+            pool = self._budget_obs[budget]
+            if len(pool) >= self.n_startup:
+                return pool
+        return self._obs  # completion pool (final-budget results)
+
+    def suggest(self, trial_id: str) -> dict | None:
+        pool = self._select_pool()
+        # swap the pool the parent's model fits on for this suggestion
+        saved, self._obs = self._obs, pool
+        try:
+            return super().suggest(trial_id)
+        finally:
+            self._obs = saved
+
+
 class BasicVariantGenerator(Searcher):
     """Grid x random expansion: the cross-product of all grid_search values,
     repeated num_samples times with random domains re-sampled per repeat."""

@@ -367,3 +367,30 @@ def test_crr_exp_mode_trains():
         m = algo.train()
         assert np.isfinite(m["actor_loss"]) and np.isfinite(m["td_loss"])
         assert m["mean_weight"] > 0
+
+
+def test_crr_checkpoint_restores_critic():
+    """CRR is the first two-Learner algorithm: save_state must carry the
+    critic or a restore filters the actor loss with a random-critic
+    advantage (round-5 review finding)."""
+    from ray_tpu.rllib.offline import CRRConfig
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "exp.jsonl")
+        _expert_corridor_data(path, n_episodes=20, noise=0.05)
+        cfg = (CRRConfig().offline_data(input_=path)
+               .training(lr=1e-2, num_epochs=1, minibatch_size=64)
+               .debugging(seed=0))
+        algo = cfg.build()
+        algo.train()
+        state = algo.save_state()
+        assert "critic" in state
+        want = algo.critic.get_weights_np()
+
+        algo2 = cfg.build()
+        algo2.load_state(state)
+        got = algo2.critic.get_weights_np()
+        import jax
+
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+            np.testing.assert_allclose(a, b)
