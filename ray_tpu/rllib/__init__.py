@@ -53,6 +53,7 @@ from ray_tpu.rllib.learner import Learner
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rl_module import (
     ActorCriticModule,
+    DistributionalQModule,
     QModule,
     RecurrentQModule,
 )
@@ -91,6 +92,7 @@ __all__ = [
     "Learner",
     "PPO",
     "PPOConfig",
+    "DistributionalQModule",
     "QModule",
     "R2D2",
     "R2D2Config",

@@ -47,6 +47,61 @@ def dqn_loss(module, params, batch, config):
     }
 
 
+def c51_loss(module, params, batch, config):
+    """C51 categorical TD loss (Bellemare et al. 2017): project the
+    Bellman-shifted target distribution onto the fixed support, minimize
+    cross-entropy. Double-DQN action selection on the EXPECTED online Q;
+    per-sample cross-entropy doubles as the PER priority signal."""
+    import jax
+    import jax.numpy as jnp
+
+    z = jnp.asarray(module.support)                       # [K]
+    K = module.n_atoms
+    dz = (module.v_max - module.v_min) / (K - 1)
+
+    logits = module.logits(params, batch["obs"])          # [B, A, K]
+    logp_taken = jax.nn.log_softmax(
+        jnp.take_along_axis(
+            logits, batch["actions"][:, None, None].repeat(K, -1), axis=1
+        )[:, 0], axis=-1)                                 # [B, K]
+
+    q_next_online = module.forward(params, batch["next_obs"])
+    best = jnp.argmax(q_next_online, axis=-1)             # [B]
+    t_logits = module.logits(batch["target_params"], batch["next_obs"])
+    p_next = jax.nn.softmax(
+        jnp.take_along_axis(
+            t_logits, best[:, None, None].repeat(K, -1), axis=1
+        )[:, 0], axis=-1)                                 # [B, K]
+    p_next = jax.lax.stop_gradient(p_next)
+
+    not_term = 1.0 - batch["terminateds"].astype(jnp.float32)
+    tz = jnp.clip(
+        batch["rewards"][:, None]
+        + batch["discounts"][:, None] * not_term[:, None] * z[None, :],
+        module.v_min, module.v_max)                       # [B, K]
+    bj = (tz - module.v_min) / dz
+    lo = jnp.floor(bj)
+    hi = jnp.ceil(bj)
+    # integer bj (lo == hi) would lose its mass to two zero weights;
+    # route it entirely to lo
+    w_lo = jnp.where(hi == lo, 1.0, hi - bj)
+    w_hi = bj - lo
+    # scatter via one-hot contraction: m[b, k] = sum_j p*(w at k)
+    m = (jnp.einsum("bj,bjk->bk", p_next * w_lo,
+                    jax.nn.one_hot(lo.astype(jnp.int32), K))
+         + jnp.einsum("bj,bjk->bk", p_next * w_hi,
+                      jax.nn.one_hot(hi.astype(jnp.int32), K)))
+    ce = -jnp.sum(m * logp_taken, axis=-1)                # [B]
+    weights = batch.get("weights")
+    loss = jnp.mean(ce if weights is None else weights * ce)
+    q_taken = jnp.sum(jnp.exp(logp_taken) * z, axis=-1)
+    return loss, {
+        "q_mean": jnp.mean(q_taken),
+        "td_abs": jnp.mean(ce),
+        "_td_abs": ce,  # PER priorities = categorical cross-entropy
+    }
+
+
 class DQNConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()
@@ -65,25 +120,55 @@ class DQNConfig(AlgorithmConfig):
         self.prioritized_replay = False
         self.per_alpha = 0.6
         self.per_beta = 0.4
+        # C51 distributional head (reference: dqn config num_atoms,
+        # v_min/v_max; Bellemare et al. 2017)
+        self.distributional = False
+        self.n_atoms = 51
+        self.v_min = -10.0
+        self.v_max = 10.0
         self.algo_class = DQN
+
+
+def _make_q_module(obs_dim: int, n_act: int, hidden: tuple, dueling: bool,
+                   distributional: bool, n_atoms: int, v_min: float,
+                   v_max: float):
+    """The ONE place learner and EnvRunner modules are constructed from —
+    a structural mismatch between the two breaks weight broadcast."""
+    if distributional:
+        if dueling:
+            raise ValueError(
+                "dueling + distributional are not combined in this build; "
+                "pick one (reference supports both only on the torch "
+                "model path)")
+        from ray_tpu.rllib.rl_module import DistributionalQModule
+
+        return DistributionalQModule(obs_dim, n_act, hidden,
+                                     n_atoms=n_atoms, v_min=v_min,
+                                     v_max=v_max)
+    return QModule(obs_dim, n_act, hidden, dueling=dueling)
 
 
 class DQN(Algorithm):
     runner_mode = "epsilon_greedy"
 
+    def _module_args(self) -> tuple:
+        cfg = self.config
+        return (tuple(cfg.hidden), cfg.dueling, cfg.distributional,
+                cfg.n_atoms, cfg.v_min, cfg.v_max)
+
     def _runner_factory(self):
-        hidden = tuple(self.config.hidden)
-        dueling = self.config.dueling
-        return lambda obs_dim, n_act: QModule(obs_dim, n_act, hidden,
-                                              dueling=dueling)
+        # close over config SCALARS only — the factory ships to EnvRunner
+        # actors and must not drag the whole Algorithm along
+        args = self._module_args()
+        return lambda obs_dim, n_act: _make_q_module(obs_dim, n_act, *args)
 
     def _build_learner(self) -> None:
         cfg = self.config
-        module = QModule(self.obs_dim, self.num_actions, cfg.hidden,
-                         dueling=cfg.dueling)
+        module = _make_q_module(self.obs_dim, self.num_actions,
+                                *self._module_args())
         self.learner = Learner(
             module,
-            dqn_loss,
+            c51_loss if cfg.distributional else dqn_loss,
             config={"gamma": cfg.gamma},  # discounts ride per-sample in batch
             learning_rate=cfg.lr,
             max_grad_norm=cfg.max_grad_norm,

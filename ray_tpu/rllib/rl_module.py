@@ -161,6 +161,57 @@ class QModule:
         return v + a - jnp.mean(a, axis=-1, keepdims=True)
 
 
+class DistributionalQModule:
+    """C51 categorical value network (Bellemare et al. 2017; reference:
+    dqn_torch_model.py num_atoms>1 path). The head emits per-action
+    logits over `n_atoms` fixed support points z in [v_min, v_max];
+    `forward`/`forward_np` collapse to the expected Q so epsilon-greedy
+    EnvRunners and the target-selection code are distribution-agnostic,
+    while `logits` exposes the full distribution to the C51 loss."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64), n_atoms: int = 51,
+                 v_min: float = -10.0, v_max: float = 10.0):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+        self.n_atoms = n_atoms
+        self.v_min = float(v_min)
+        self.v_max = float(v_max)
+        self.support = np.linspace(v_min, v_max, n_atoms).astype(np.float32)
+
+    def init(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        dims = [self.obs_dim, *self.hidden, self.num_actions * self.n_atoms]
+        return {
+            "q": [
+                _init_linear(rng, dims[i], dims[i + 1],
+                             np.sqrt(2) if i < len(dims) - 2 else 0.01)
+                for i in range(len(dims) - 1)
+            ]
+        }
+
+    def logits(self, params, obs):
+        """[B, num_actions, n_atoms] (jax)."""
+        out = _mlp_jax(params["q"], obs)
+        return out.reshape(*out.shape[:-1], self.num_actions, self.n_atoms)
+
+    def forward(self, params, obs):
+        import jax
+        import jax.numpy as jnp
+
+        probs = jax.nn.softmax(self.logits(params, obs), axis=-1)
+        return jnp.sum(probs * jnp.asarray(self.support), axis=-1)
+
+    def forward_np(self, params: dict, obs: np.ndarray) -> np.ndarray:
+        out = ActorCriticModule._mlp_np(params["q"], obs)
+        out = out.reshape(*out.shape[:-1], self.num_actions, self.n_atoms)
+        out = out - out.max(axis=-1, keepdims=True)
+        p = np.exp(out)
+        p /= p.sum(axis=-1, keepdims=True)
+        return (p * self.support).sum(axis=-1)
+
+
 class DeterministicPolicyModule:
     """Actor-critic pair for continuous control: tanh-bounded deterministic
     actor pi(s) and twin Q(s, a) critics (reference: rllib's DDPG/TD3

@@ -350,7 +350,10 @@ class HyperBandForBOHB(HyperBandScheduler):
         tid = trial.trial_id
         t = result.get(self.time_attr, trial.iteration)
         if (self._searcher is not None and t >= self.milestone
-                and tid not in self._scores):
+                and t < self.max_t and tid not in self._scores):
+            # the t >= max_t retire path never records a milestone score,
+            # so feeding it here would mislabel a full-budget observation
+            # with the current (lower) barrier's budget
             # first report at/after the current barrier: this is the score
             # HyperBand will judge at budget=milestone — tell the model
             self._searcher.on_budget_result(tid, self.milestone, result)

@@ -379,11 +379,15 @@ class TuneBOHB(TPESearcher):
         self._budget_obs.setdefault(float(budget), []).append((flat, score))
 
     def _select_pool(self) -> list[tuple[dict, float]]:
+        # completions ran to max_t — the HIGHEST fidelity pool of all, so
+        # it is consulted first, then milestone pools in descending budget
+        if len(self._obs) >= self.n_startup:
+            return self._obs
         for budget in sorted(self._budget_obs, reverse=True):
             pool = self._budget_obs[budget]
             if len(pool) >= self.n_startup:
                 return pool
-        return self._obs  # completion pool (final-budget results)
+        return self._obs
 
     def suggest(self, trial_id: str) -> dict | None:
         pool = self._select_pool()
