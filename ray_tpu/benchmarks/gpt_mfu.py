@@ -60,9 +60,10 @@ def run_gpt_bench(
         # rematerialization trades ~1 extra forward for dropping the
         # saved per-layer residuals (scan or unrolled alike)
         cfg = dataclasses.replace(cfg, remat=True)
-    if seq_len < cfg.max_seq_len:
-        # benching a shorter context: positional table slices down free
-        pass
+    if seq_len > cfg.max_seq_len:
+        # long-context bench shapes: grow the positional table (a shorter
+        # context slices down free)
+        cfg = dataclasses.replace(cfg, max_seq_len=seq_len)
     n_params = gpt_num_params(cfg)
     model_label = _model_label(config, n_params)
     params = gpt_init(jax.random.PRNGKey(0), cfg)

@@ -62,3 +62,11 @@ __all__ += ["RecSysEnv", "SlateQ", "SlateQConfig"]
 from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
 
 __all__ += ["ARS", "ARSConfig"]
+
+from ray_tpu.rllib.algorithms.maddpg import (
+    MADDPG,
+    MADDPGConfig,
+    ParticleMeet,
+)
+
+__all__ += ["MADDPG", "MADDPGConfig", "ParticleMeet"]

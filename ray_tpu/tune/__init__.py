@@ -21,6 +21,7 @@ from ray_tpu.tune.search import (
     SuggestAdapter,
     BasicVariantGenerator,
     Searcher,
+    BayesOptSearcher,
     TPESearcher,
     TuneBOHB,
     choice,
@@ -63,6 +64,7 @@ __all__ = [
     "choice",
     "get_checkpoint",
     "grid_search",
+    "BayesOptSearcher",
     "TPESearcher",
     "TuneBOHB",
     "loguniform",

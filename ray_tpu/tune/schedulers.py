@@ -425,24 +425,10 @@ class PB2(PopulationBasedTraining):
             for _ in range(self.n_candidates)
         ])
         if len(self._obs_y) >= 3:
-            X = np.asarray(self._obs_x)
-            y = np.asarray(self._obs_y)
-            y_mean, y_std = y.mean(), y.std() or 1.0
-            yn = (y - y_mean) / y_std
-            ls, noise = 0.3, 1e-3
+            from ray_tpu.tune._gp import gp_ucb_select
 
-            def rbf(a, b):
-                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
-                return np.exp(-d2 / (2 * ls * ls))
-
-            K = rbf(X, X) + noise * np.eye(len(X))
-            Ks = rbf(cand, X)
-            alpha = np.linalg.solve(K, yn)
-            mu = Ks @ alpha
-            v = np.linalg.solve(K, Ks.T)
-            var = np.clip(1.0 - (Ks * v.T).sum(-1), 1e-9, None)
-            ucb = mu + self.kappa * np.sqrt(var)
-            best = cand[int(np.argmax(ucb))]
+            best = gp_ucb_select(self._obs_x, self._obs_y, cand,
+                                 kappa=self.kappa)
         else:
             best = cand[0]  # cold start: random draw inside the bounds
         for k, u in zip(keys, best):

@@ -178,6 +178,43 @@ class SuggestAdapter(Searcher):
             pass  #                         not take down the experiment
 
 
+def _partition_space(param_space: dict, searcher_name: str,
+                     allow_choice: bool = True):
+    """(dims, fixed, deferred) split shared by the model-based searchers;
+    grid domains are rejected uniformly."""
+    grids, others = _split_spec(param_space)
+    if grids:
+        raise ValueError(f"{searcher_name} does not accept grid_search "
+                         "domains; use BasicVariantGenerator")
+    dims, fixed, deferred = [], [], []
+    for path, v in others:
+        if not allow_choice and isinstance(v, Choice):
+            raise ValueError(
+                f"{searcher_name} models numeric domains only (reference "
+                "bayesopt has the same limit); use TPESearcher for "
+                "categorical spaces")
+        if isinstance(v, SampleFrom):
+            deferred.append((path, v))
+        elif isinstance(v, Domain):
+            dims.append((path, v))
+        else:
+            fixed.append((path, v))
+    return dims, fixed, deferred
+
+
+def _assemble_config(fixed, deferred, dim_values) -> dict:
+    """fixed + modeled dim values + deferred sample_from (which may read
+    the already-set keys), in that order."""
+    cfg: dict = {}
+    for path, v in fixed:
+        _set_path(cfg, path, v)
+    for path, v in dim_values:
+        _set_path(cfg, path, v)
+    for path, v in deferred:
+        _set_path(cfg, path, v.fn(cfg))
+    return cfg
+
+
 class TPESearcher(Searcher):
     """Native Tree-structured Parzen Estimator searcher (Bergstra et al.
     2011) — the model behind Optuna's default sampler and HyperOpt
@@ -200,20 +237,8 @@ class TPESearcher(Searcher):
                  mode: str | None = None, n_startup: int = 10,
                  gamma: float = 0.25, n_candidates: int = 24,
                  max_trials: int | None = None, seed: int | None = None):
-        grids, others = _split_spec(param_space)
-        if grids:
-            raise ValueError("TPESearcher does not accept grid_search "
-                             "domains; use BasicVariantGenerator")
-        self._dims: list[tuple[tuple, Any]] = []  # (path, Domain) to model
-        self._fixed: list[tuple[tuple, Any]] = []
-        self._deferred: list[tuple[tuple, SampleFrom]] = []
-        for path, v in others:
-            if isinstance(v, SampleFrom):
-                self._deferred.append((path, v))
-            elif isinstance(v, Domain):
-                self._dims.append((path, v))
-            else:
-                self._fixed.append((path, v))
+        self._dims, self._fixed, self._deferred = _partition_space(
+            param_space, "TPESearcher")
         self.metric, self.mode = metric, mode
         self.n_startup = n_startup
         self.gamma = gamma
@@ -332,13 +357,7 @@ class TPESearcher(Searcher):
             flat = self._random_config()
         else:
             flat = self._tpe_config()
-        cfg: dict = {}
-        for path, v in self._fixed:
-            _set_path(cfg, path, v)
-        for path, v in flat.items():
-            _set_path(cfg, path, v)
-        for path, v in self._deferred:
-            _set_path(cfg, path, v.fn(cfg))
+        cfg = _assemble_config(self._fixed, self._deferred, flat.items())
         self._live[trial_id] = flat
         return cfg
 
@@ -397,6 +416,75 @@ class TuneBOHB(TPESearcher):
             return super().suggest(trial_id)
         finally:
             self._obs = saved
+
+
+class BayesOptSearcher(Searcher):
+    """Native GP-UCB Bayesian optimization (reference:
+    tune/search/bayesopt/bayesopt_search.py, which wraps the external
+    `bayesian-optimization` package; this is an in-tree numpy RBF-GP —
+    the same regressor PB2 uses — with an upper-confidence-bound
+    acquisition over unit-cube candidates). Numeric domains only, like
+    the reference (categoricals want TPESearcher)."""
+
+    def __init__(self, param_space: dict, *, metric: str | None = None,
+                 mode: str | None = None, n_startup: int = 5,
+                 kappa: float = 2.0, n_candidates: int = 256,
+                 max_trials: int | None = None, seed: int | None = None):
+        self._dims, self._fixed, self._deferred = _partition_space(
+            param_space, "BayesOptSearcher", allow_choice=False)
+        self.metric, self.mode = metric, mode
+        self.n_startup = n_startup
+        self.kappa = kappa
+        self.n_candidates = n_candidates
+        self._max_trials = max_trials
+        self._suggested = 0
+        self.rng = random.Random(seed)
+        self._live: dict[str, dict] = {}   # trial_id -> unit coords
+        self._obs: list[tuple[list[float], float]] = []
+
+    def set_search_properties(self, metric, mode):
+        if self.metric is None:
+            self.metric = metric
+        if self.mode is None:
+            self.mode = mode
+
+    def _acquire(self) -> list[float]:
+        import numpy as np
+
+        from ray_tpu.tune._gp import gp_ucb_select
+
+        d = len(self._dims)
+        cand = np.array([[self.rng.random() for _ in range(d)]
+                         for _ in range(self.n_candidates)])
+        best = gp_ucb_select([u for u, _ in self._obs],
+                             [s for _, s in self._obs], cand,
+                             ls=0.2, noise=1e-4, kappa=self.kappa)
+        return [float(u) for u in best]
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._max_trials is not None and self._suggested >= self._max_trials:
+            return None
+        self._suggested += 1
+        if len(self._obs) < self.n_startup:
+            units = [self.rng.random() for _ in self._dims]
+        else:
+            units = self._acquire()
+        cfg = _assemble_config(
+            self._fixed, self._deferred,
+            [(path, TPESearcher._from_unit(dom, u))
+             for (path, dom), u in zip(self._dims, units)])
+        self._live[trial_id] = units
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False) -> None:
+        units = self._live.pop(trial_id, None)
+        if units is None or error or result is None or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._obs.append((units, score))
 
 
 class BasicVariantGenerator(Searcher):

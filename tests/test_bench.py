@@ -67,3 +67,15 @@ def test_cpu_inner_run_emits_gpt_headline(tmp_path):
     assert result["value"] > 0
     # CPU numbers never pollute the device cache
     assert not (tmp_path / "lg.json").exists()
+
+
+def test_gpt_bench_grows_positional_table_for_long_seq(jax_cpu):
+    """BENCH_GPT_SEQ beyond the config's max_seq_len must extend the
+    positional table instead of a broadcast error (round-5 long-context
+    entries bench seq 8192/16384 against the 1024 default)."""
+    from ray_tpu.benchmarks.gpt_mfu import run_gpt_bench
+
+    result = run_gpt_bench(config="tiny", batch_size=2, seq_len=256,
+                           steps=2, warmup=1, chunk=2)
+    assert result["seq_len"] == 256  # tiny max_seq_len is 128
+    assert result["value"] > 0

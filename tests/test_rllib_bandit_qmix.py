@@ -99,3 +99,46 @@ def test_ppo_conv_policy_learns_minibreakout(jax_cpu):
             break
     assert best >= 1.0, f"conv PPO made no progress: best={best}"
     algo.stop()
+
+
+def test_maddpg_agents_reach_landmark(jax_cpu):
+    """MADDPG (centralized critics, decentralized actors) learns the
+    cooperative ParticleMeet: mean distance to the landmark shrinks and
+    episode return improves over training (reference: rllib_contrib/
+    maddpg — the continuous multi-agent family QMIX doesn't cover)."""
+    import numpy as np
+    from ray_tpu.rllib.algorithms import MADDPGConfig
+
+    algo = (
+        MADDPGConfig()
+        .training(n_agents=2, episode_len=20, rollout_episodes=6,
+                  learning_starts=256, updates_per_iteration=24,
+                  minibatch_size=128, lr=2e-3, exploration_noise=0.4,
+                  noise_decay_steps=4000)
+        .debugging(seed=0)
+        .build()
+    )
+    first = algo.train()["episode_return_mean"]
+    last = {}
+    for _ in range(20):
+        last = algo.train()
+    assert last["episode_return_mean"] > first + 0.5, (
+        first, last["episode_return_mean"])
+    # decentralized greedy execution actually steers toward the landmark
+    env = algo.env
+    obs = env.reset(seed=123)
+    d0 = float(np.linalg.norm(env.pos - env.landmark, axis=-1).mean())
+    for _ in range(20):
+        obs, r, term, trunc = env.step(algo.compute_actions(obs))
+    d1 = float(np.linalg.norm(env.pos - env.landmark, axis=-1).mean())
+    assert d1 < d0 * 0.65, (d0, d1)
+
+    # self-contained checkpointing round-trips
+    state = algo.save_state()
+    algo2 = (MADDPGConfig()
+             .training(n_agents=2, episode_len=20).debugging(seed=1).build())
+    algo2.load_state(state)
+    import jax
+    for a, b in zip(jax.tree.leaves(algo.params),
+                    jax.tree.leaves(algo2.params)):
+        np.testing.assert_allclose(a, b)

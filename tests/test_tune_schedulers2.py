@@ -291,3 +291,36 @@ def test_bohb_end_to_end(ray_start):
     assert abs(best.config["x"] - 0.7) < 0.35
     # milestone pools were fed by the scheduler
     assert any(len(v) >= 4 for v in searcher._budget_obs.values())
+
+
+def test_bayesopt_searcher_concentrates():
+    """GP-UCB: after startup, suggestions concentrate near the optimum of
+    a smooth 2D objective (reference: tune/search/bayesopt)."""
+    from ray_tpu import tune
+    from ray_tpu.tune.search import BayesOptSearcher
+
+    s = BayesOptSearcher(
+        {"x": tune.uniform(0.0, 1.0), "y": tune.uniform(0.0, 1.0)},
+        metric="score", mode="max", n_startup=8, kappa=1.0, seed=3)
+
+    def objective(cfg):
+        return -(cfg["x"] - 0.3) ** 2 - (cfg["y"] - 0.8) ** 2
+
+    for i in range(30):
+        cfg = s.suggest(f"t{i}")
+        s.on_trial_complete(f"t{i}", {"score": objective(cfg)})
+    tail = [s.suggest(f"f{i}") for i in range(5)]
+    # model-based tail suggestions sit near (0.3, 0.8)
+    assert sum(abs(c["x"] - 0.3) < 0.25 and abs(c["y"] - 0.8) < 0.25
+               for c in tail) >= 3, tail
+
+
+def test_bayesopt_rejects_categorical():
+    import pytest as _pytest
+
+    from ray_tpu import tune
+    from ray_tpu.tune.search import BayesOptSearcher
+
+    with _pytest.raises(ValueError, match="numeric"):
+        BayesOptSearcher({"opt": tune.choice(["adam", "sgd"])},
+                         metric="score", mode="max")
