@@ -239,7 +239,7 @@ def _outer() -> None:
     import subprocess
     import sys
 
-    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "540"))
 
     def attempt(extra_env: dict, share: float) -> dict | None:
         env = dict(os.environ, BENCH_INNER="1",
@@ -261,7 +261,11 @@ def _outer() -> None:
             return None
         return None
 
-    result = attempt({}, 0.60)
+    # 0.75 share: a successful device run needs the headroom for the aux
+    # CPU benches (overhead + PPO) AFTER the model entries — at 0.60 the
+    # inner watchdog's gate skipped them with 200 s of outer budget unused.
+    # The stall path still fits: 0.75 + grace + 0.25 CPU ≈ 1.1x budget.
+    result = attempt({}, 0.75)
     if result is None or result.get("value", 0) <= 0:
         # device backend unreachable: measure on CPU so a REAL number
         # lands, tagged by platform in the metric name + an explicit flag
@@ -272,7 +276,7 @@ def _outer() -> None:
                        "BENCH_SKIP_RESNET": "1",
                        "BENCH_SIMULATE_STALL": "",
                        "BENCH_DTYPE": "float32"},
-                      0.35)
+                      0.25)
         if cpu is not None:
             cpu["tpu_stalled"] = True
             result = cpu
@@ -394,35 +398,54 @@ def main() -> None:
         kwargs.setdefault("steps", steps)
         return run_resnet_bench(**kwargs)
 
-    if not os.environ.get("BENCH_SKIP_RESNET"):
-        aux_bench(_resnet, "resnet", 75.0)
-
-    def aux_subprocess(module: str, key: str, min_budget: float) -> None:
-        """CPU-subprocess metric (orchestration parity numbers): runs only
-        with budget to spare and merges ONE key into the result."""
+    def aux_spawn(module: str, min_budget: float):
+        """Start a CPU-subprocess metric; returns the Popen or None."""
         remaining = budget - (time.monotonic() - start) - 30.0
         if remaining <= min_budget:
-            return
+            return None
         try:
             import subprocess
 
             env = dict(os.environ, JAX_PLATFORMS="cpu")
-            r = subprocess.run(
+            return subprocess.Popen(
                 [sys.executable, "-m", module],
-                capture_output=True, text=True, timeout=remaining, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env,
             )
-            if r.returncode == 0:
-                parsed = json.loads(r.stdout.strip().splitlines()[-1])
+        except Exception:
+            return None
+
+    def aux_collect(proc, key: str) -> None:
+        if proc is None:
+            return
+        try:
+            remaining = max(5.0, budget - (time.monotonic() - start) - 15.0)
+            out, _ = proc.communicate(timeout=remaining)
+            if proc.returncode == 0:
+                parsed = json.loads(out.strip().splitlines()[-1])
                 _merge_key(key, parsed[key])
         except Exception:
-            pass
+            try:
+                proc.kill()
+            except Exception:
+                pass
 
     # the reference's REAL acceptance bar (<=~2.5% vs native,
-    # benchmarks.rst:56), then the second north-star metric (BASELINE.json)
-    aux_subprocess("ray_tpu.benchmarks.trainer_overhead",
-                   "trainer_overhead_pct", 60.0)
-    aux_subprocess("ray_tpu.benchmarks.rllib_throughput",
-                   "ppo_env_steps_per_sec", 90.0)
+    # benchmarks.rst:56): launched BEFORE resnet so it overlaps the
+    # ~2.5 min resnet compile — the alternative is dropping the PPO
+    # metric entirely for budget. The paired-interleaved-arms design
+    # keeps the delta honest under load; measured concurrent runs stay
+    # inside the documented ±0.6 pt noise band (docs/MICROBENCHMARKS.md)
+    overhead_proc = aux_spawn("ray_tpu.benchmarks.trainer_overhead", 60.0)
+
+    if not os.environ.get("BENCH_SKIP_RESNET"):
+        aux_bench(_resnet, "resnet", 75.0)
+
+    aux_collect(overhead_proc, "trainer_overhead_pct")
+    # second north-star metric (BASELINE.json): contention-SENSITIVE, so
+    # it runs alone after everything else, with whatever budget remains
+    ppo_proc = aux_spawn("ray_tpu.benchmarks.rllib_throughput", 75.0)
+    aux_collect(ppo_proc, "ppo_env_steps_per_sec")
 
     final = _current_result() or {}
     _save_last_good(final)

@@ -16,11 +16,10 @@ jitted per-agent actor/critic updates with Polyak-averaged targets.
 """
 from __future__ import annotations
 
-from functools import partial
-
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rl_module import ActorCriticModule, _init_linear
 
 
@@ -113,16 +112,15 @@ class MADDPG(Algorithm):
         self.target_params = jax.tree.map(np.copy, self.params)
         import optax
 
-        from collections import deque
-
         self._tx = optax.adam(cfg.lr)
         self._opt = [self._tx.init(p) for p in self.params]
-        # deque(maxlen): O(1) eviction once full (a list's pop(0) is
-        # O(capacity) per appended transition)
-        self._buf: deque = deque(maxlen=cfg.buffer_capacity)
+        # the shared preallocated ring buffer, joint rows flattened to
+        # [n*od] / [n*ad] — O(1) vectorized add/sample like the rest of
+        # the off-policy family
+        self._buf = ReplayBuffer(cfg.buffer_capacity, n * od,
+                                 seed=cfg.seed or 0, action_dim=n * ad)
         self._rng = rng
         self._env_steps = 0
-        self._iter = 0
         self._jit_update = jax.jit(self._update_impl)
 
     def _build_learner(self) -> None:  # pragma: no cover — self-contained
@@ -211,37 +209,46 @@ class MADDPG(Algorithm):
         return new_params, new_targets, new_opts, metrics
 
     def training_step(self) -> dict:
-        import jax
-
         cfg = self.config
-        self._iter += 1
+        n, od, ad = self.n_agents, self.obs_dim, self.action_dim
         returns = []
         for _ in range(cfg.rollout_episodes):
             obs = self.env.reset()
+            ep = {"obs": [], "acts": [], "rew": [], "next": [], "term": []}
             ep_ret = 0.0
             for _t in range(cfg.episode_len):
                 acts = self._act(obs, self._noise())
                 next_obs, rew, term, trunc = self.env.step(acts)
-                self._buf.append((obs, acts, rew, next_obs, float(term)))
+                ep["obs"].append(obs.reshape(-1))
+                ep["acts"].append(acts.reshape(-1))
+                ep["rew"].append(rew)
+                ep["next"].append(next_obs.reshape(-1))
+                ep["term"].append(term)
                 obs = next_obs
                 ep_ret += rew
                 self._env_steps += 1
                 if term or trunc:
                     break
+            self._buf.add_batch(
+                np.asarray(ep["obs"], np.float32),
+                np.asarray(ep["acts"], np.float32),
+                np.asarray(ep["rew"], np.float32),
+                np.asarray(ep["next"], np.float32),
+                np.asarray(ep["term"], np.bool_),
+            )
             returns.append(ep_ret)
 
         metrics_acc: dict[str, list[float]] = {}
         if len(self._buf) >= cfg.learning_starts:
             for _ in range(cfg.updates_per_iteration):
-                idx = self._rng.integers(0, len(self._buf),
-                                         cfg.minibatch_size)
-                rows = [self._buf[j] for j in idx]
+                mb = self._buf.sample(cfg.minibatch_size)
+                B = len(mb["rewards"])
                 batch = {
-                    "obs": np.stack([r[0] for r in rows]),
-                    "actions": np.stack([r[1] for r in rows]),
-                    "rewards": np.asarray([r[2] for r in rows], np.float32),
-                    "next_obs": np.stack([r[3] for r in rows]),
-                    "dones": np.asarray([r[4] for r in rows], np.float32),
+                    "obs": mb["obs"].reshape(B, n, od),
+                    "actions": mb["actions"].reshape(B, n, ad),
+                    "rewards": mb["rewards"],
+                    "next_obs": mb["next_obs"].reshape(B, n, od),
+                    "dones": mb["terminateds"].astype(np.float32),
                 }
                 self.params, self.target_params, self._opt, m = (
                     self._jit_update(self.params, self.target_params,
