@@ -1,51 +1,49 @@
-"""Stream LLM-style token decode through Serve — handle, HTTP SSE, gRPC.
+"""Stream REAL model tokens through Serve — handle, HTTP SSE, gRPC.
 
-The flagship TPU serving pattern (reference: serve streaming responses,
-doc/source/serve/tutorials/streaming): a generator deployment yields one
-token at a time; the chunks reach the client AS PRODUCED through three
-ingress paths — the in-process DeploymentHandle, the HTTP proxy as
-server-sent events, and the gRPC ingress's server-streaming RPC.
+The flagship TPU serving pattern: the continuous-batching LLM engine
+(ray_tpu.serve.llm — paged KV cache + bucketed prefill/decode scheduling)
+runs LlamaConfig.tiny() inside a Serve replica and streams one token per
+decode step through three ingress paths — the in-process DeploymentHandle,
+the HTTP proxy as server-sent events, and the gRPC ingress's
+server-streaming RPC. Greedy decoding makes the three paths token-exact
+replicas of each other.
 
 Run: python examples/serve_streaming_llm.py
 """
 import json
-import time
 import urllib.request
 
 import ray_tpu
 from ray_tpu import serve
+from ray_tpu.serve.llm import EngineConfig, build_llm_app
 
 HTTP_PORT = 18411
+PROMPT = "hello"
+N_TOKENS = 8
 
 
 def main():
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     serve.start(http_options={"port": HTTP_PORT}, grpc_options={"port": 0})
 
-    @serve.deployment(num_replicas=1)
-    class Decoder:
-        """Stand-in for a jitted decode loop: one token per step."""
-
-        def __call__(self, payload):
-            prompt = (payload or {}).get("prompt", "")
-            for i, word in enumerate(f"echo:{prompt}".split(":")):
-                yield {"token": word, "index": i}
-                time.sleep(0.05)
-
-    handle = serve.run(Decoder.bind(), name="llm", route_prefix="/llm")
+    # LlamaConfig.tiny() by default; a larger model is EngineConfig(
+    #   model_config=LlamaConfig(...), num_blocks=..., block_size=32)
+    app = build_llm_app(EngineConfig(model="llama", seed=0))
+    handle = serve.run(app, name="llm", route_prefix="/llm")
+    payload = {"prompt": PROMPT, "max_new_tokens": N_TOKENS}
 
     # 1. handle: iterate the DeploymentResponseGenerator
-    tokens = [c["token"] for c in handle.remote({"prompt": "hello"})]
+    tokens = [c["token"] for c in handle.remote(payload)]
     print("handle stream:", tokens)
 
     # 2. HTTP: server-sent events
     req = urllib.request.Request(
         f"http://127.0.0.1:{HTTP_PORT}/llm",
-        data=json.dumps({"prompt": "world"}).encode(),
+        data=json.dumps(payload).encode(),
         headers={"Accept": "text/event-stream"},
     )
     sse = []
-    with urllib.request.urlopen(req, timeout=60) as resp:
+    with urllib.request.urlopen(req, timeout=120) as resp:
         for line in resp:
             if line.startswith(b"data: "):
                 sse.append(json.loads(line[6:])["token"])
@@ -61,14 +59,15 @@ def main():
         response_deserializer=lambda b: b,
     )
     rpc = [json.loads(c)["result"]["token"]
-           for c in stream(json.dumps({"prompt": "grpc"}).encode(),
-                           metadata=(("application", "llm"),), timeout=60)]
+           for c in stream(json.dumps(payload).encode(),
+                           metadata=(("application", "llm"),), timeout=120)]
     ch.close()
     print("gRPC stream:", rpc)
 
-    assert tokens == ["echo", "hello"]
-    assert sse == ["echo", "world"]
-    assert rpc == ["echo", "grpc"]
+    # greedy decode: every ingress path must produce the same real tokens
+    assert len(tokens) == N_TOKENS
+    assert sse == tokens
+    assert rpc == tokens
     serve.shutdown()
     return tokens, sse, rpc
 

@@ -133,22 +133,52 @@ def gpt_param_axes(cfg: GPTConfig | None = None) -> dict:
     }
 
 
+def _attn_qkv(x, bp, cfg: GPTConfig):
+    """ln1 + fused QKV projection. x: [B, S, D] -> q, k, v [B, S, H, hd].
+    Shared by the full-sequence block and the KV-cached prefill/decode
+    paths (serve/llm) so the projection math exists exactly once."""
+    B, S, _ = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+    qkv = (h @ bp["qkv_w"].astype(cfg.dtype)) + bp["qkv_b"].astype(cfg.dtype)
+    q, kk, vv = jnp.split(qkv, 3, axis=-1)
+    return (
+        q.reshape(B, S, H, hd),
+        kk.reshape(B, S, H, hd),
+        vv.reshape(B, S, H, hd),
+    )
+
+
+def _attn_residual(x, attn, bp, cfg: GPTConfig):
+    """Output projection + residual. attn: [B, S, D] (heads merged)."""
+    return x + (attn @ bp["proj_w"].astype(cfg.dtype)) + bp["proj_b"].astype(
+        cfg.dtype
+    )
+
+
+def _mlp_residual(x, bp, cfg: GPTConfig, constrain=None):
+    h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    h = gelu((h @ bp["mlp_in_w"].astype(cfg.dtype)) + bp["mlp_in_b"].astype(cfg.dtype))
+    if constrain is not None:
+        h = constrain(h, ("batch", "seq", "mlp"))
+    return x + (h @ bp["mlp_out_w"].astype(cfg.dtype)) + bp["mlp_out_b"].astype(
+        cfg.dtype
+    )
+
+
 def _block(x, bp, cfg: GPTConfig, rules: ShardingRules | None, mesh):
     """One transformer block. x: [B, S, D] in cfg.dtype."""
     B, S, D = x.shape
-    H, hd = cfg.n_head, cfg.head_dim
 
     def constrain(t, axes):
         if mesh is None:
             return t
         return with_logical_constraint(t, axes, rules, mesh)
 
-    h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-    qkv = (h @ bp["qkv_w"].astype(cfg.dtype)) + bp["qkv_b"].astype(cfg.dtype)
-    q, kk, vv = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    kk = kk.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    vv = vv.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    q, kk, vv = _attn_qkv(x, bp, cfg)
+    q = q.transpose(0, 2, 1, 3)
+    kk = kk.transpose(0, 2, 1, 3)
+    vv = vv.transpose(0, 2, 1, 3)
     q = constrain(q, ("batch", "heads", None, None))
 
     if cfg.attention == "flash":
@@ -161,12 +191,8 @@ def _block(x, bp, cfg: GPTConfig, rules: ShardingRules | None, mesh):
         attn = mha_reference(q, kk, vv, causal=True)
 
     attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
-    x = x + (attn @ bp["proj_w"].astype(cfg.dtype)) + bp["proj_b"].astype(cfg.dtype)
-
-    h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-    h = gelu((h @ bp["mlp_in_w"].astype(cfg.dtype)) + bp["mlp_in_b"].astype(cfg.dtype))
-    h = constrain(h, ("batch", "seq", "mlp"))
-    x = x + (h @ bp["mlp_out_w"].astype(cfg.dtype)) + bp["mlp_out_b"].astype(cfg.dtype)
+    x = _attn_residual(x, attn, bp, cfg)
+    x = _mlp_residual(x, bp, cfg, constrain)
     return constrain(x, ("batch", "seq", "embed"))
 
 
@@ -272,6 +298,120 @@ def gpt_loss(
     if mask is not None:
         return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1)
     return -jnp.mean(ll)
+
+
+# ----------------------------------------------------------------------------
+# KV-cached inference paths (serve/llm engine). Shapes are static in
+# (batch, padded length, blocks-per-seq) so the engine's bucketing bounds
+# the XLA compile cache. Cache layout: [n_layer, num_blocks, block_size,
+# n_head, head_dim] (ops/kv_cache.py; block 0 is the garbage sink).
+# ----------------------------------------------------------------------------
+
+
+def gpt_prefill(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    block_tables: jax.Array,
+    cfg: GPTConfig,
+):
+    """Prompt pass: run the full causal forward over right-padded prompts,
+    writing every valid position's K/V into the paged cache.
+
+    tokens [B, S] int32, lengths [B] (valid prefix per row; padding rows
+    use length 1 + an all-garbage block table), block_tables [B, S//Bs].
+    Returns (last-valid-token logits [B, V] f32, cache_k', cache_v').
+    Attention uses the XLA reference kernel — prefill happens once per
+    request at bucketed shapes, where flash's grid setup buys nothing.
+    """
+    from ray_tpu.ops.kv_cache import write_kv
+
+    B, S = tokens.shape
+    D = cfg.d_model
+    x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(
+        cfg.dtype
+    )[:S]
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    valid = pos < lengths[:, None]
+
+    def body(x, xs):
+        bp, k_layer, v_layer = xs
+        q, kk, vv = _attn_qkv(x, bp, cfg)
+        k_layer, v_layer = write_kv(
+            k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
+        )
+        attn = mha_reference(
+            q.transpose(0, 2, 1, 3),
+            kk.transpose(0, 2, 1, 3),
+            vv.transpose(0, 2, 1, 3),
+            causal=True,
+        )
+        x = _attn_residual(x, attn.transpose(0, 2, 1, 3).reshape(B, S, D), bp, cfg)
+        x = _mlp_residual(x, bp, cfg)
+        return x, (k_layer, v_layer)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache_k, cache_v)
+    )
+    h = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    h_last = h[jnp.arange(B), lengths - 1]  # [B, D]
+    logits = jnp.einsum(
+        "bd,vd->bv", h_last.astype(cfg.dtype), params["wte"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, cache_k, cache_v
+
+
+def gpt_decode_step(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+    block_tables: jax.Array,
+    cfg: GPTConfig,
+):
+    """One incremental decode step for a batch of sequences.
+
+    tokens [B] int32 (each sequence's newest token), positions [B] (its
+    logical position), block_tables [B, NB]. Writes the token's K/V, then
+    attends over the gathered paged context (mask includes self). Padding
+    rows point at the garbage block with position 0.
+    Returns (next-token logits [B, V] f32, cache_k', cache_v').
+    """
+    from ray_tpu.ops.kv_cache import paged_attention, write_kv
+
+    B = tokens.shape[0]
+    D = cfg.d_model
+    x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(
+        cfg.dtype
+    )[positions]
+    x = x[:, None, :]  # [B, 1, D]
+
+    def body(x, xs):
+        bp, k_layer, v_layer = xs
+        q, kk, vv = _attn_qkv(x, bp, cfg)  # [B, 1, H, hd]
+        k_layer, v_layer = write_kv(
+            k_layer, v_layer, kk[:, 0], vv[:, 0], positions, block_tables
+        )
+        attn = paged_attention(
+            q[:, 0], k_layer, v_layer, block_tables, positions
+        )
+        x = _attn_residual(x, attn.reshape(B, 1, D), bp, cfg)
+        x = _mlp_residual(x, bp, cfg)
+        return x, (k_layer, v_layer)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache_k, cache_v)
+    )
+    h = layer_norm(x[:, 0], params["ln_f_scale"], params["ln_f_bias"])
+    logits = jnp.einsum(
+        "bd,vd->bv", h.astype(cfg.dtype), params["wte"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, cache_k, cache_v
 
 
 def gpt_num_params(cfg: GPTConfig) -> int:

@@ -167,21 +167,52 @@ def _moe_cfg(cfg: LlamaConfig) -> MoEConfig:
     )
 
 
+def _attn_qkv(x, bp, cos, sin, cfg: LlamaConfig, positions=None):
+    """rms_norm + Q/K/V projections with RoPE applied at the true position
+    (``positions`` [B, S] indexes the cos/sin tables; None = 0..S-1).
+    Returns q [B, S, Hq, hd] and k, v [B, S, Hkv, hd] — kv heads NOT yet
+    repeated, so the KV-cached path (serve/llm) stores the compact GQA
+    heads. Shared by the full-sequence block and prefill/decode."""
+    B, S, _ = x.shape
+    Hq, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    h = rms_norm(x, bp["ln1_scale"])
+    q = (h @ bp["wq"].astype(cfg.dtype)).reshape(B, S, Hq, hd)
+    kk = (h @ bp["wk"].astype(cfg.dtype)).reshape(B, S, Hkv, hd)
+    vv = (h @ bp["wv"].astype(cfg.dtype)).reshape(B, S, Hkv, hd)
+    q = rope(q, cos, sin, positions)
+    kk = rope(kk, cos, sin, positions)
+    return q, kk, vv
+
+
+def _ffn_residual(x, bp, cfg: LlamaConfig, constrain=None):
+    """ln2 + (SwiGLU | MoE) + residual. Returns (x, aux_loss)."""
+    B, S, D = x.shape
+    h = rms_norm(x, bp["ln2_scale"])
+    if cfg.num_experts:
+        flat = h.reshape(B * S, D)
+        moe_params = {
+            "router": bp["moe_router"],
+            "w_in": bp["moe_w_in"],
+            "w_out": bp["moe_w_out"],
+        }
+        out, aux = moe_forward(moe_params, flat, _moe_cfg(cfg))
+        return x + out.reshape(B, S, D), aux
+    h2 = _swiglu(h, bp["mlp_in"], bp["mlp_out"], cfg.dtype)
+    if constrain is not None:
+        h2 = constrain(h2, ("batch", "seq", "embed"))
+    return x + h2, jnp.zeros((), jnp.float32)
+
+
 def _block(x, bp, cos, sin, cfg: LlamaConfig, rules, mesh):
     B, S, D = x.shape
-    Hq, Hkv, hd, g = cfg.n_head, cfg.n_kv_head, cfg.head_dim, cfg.kv_groups
+    Hq, hd, g = cfg.n_head, cfg.head_dim, cfg.kv_groups
 
     def constrain(t, axes):
         if mesh is None:
             return t
         return with_logical_constraint(t, axes, rules, mesh)
 
-    h = rms_norm(x, bp["ln1_scale"])
-    q = (h @ bp["wq"].astype(cfg.dtype)).reshape(B, S, Hq, hd)
-    kk = (h @ bp["wk"].astype(cfg.dtype)).reshape(B, S, Hkv, hd)
-    vv = (h @ bp["wv"].astype(cfg.dtype)).reshape(B, S, Hkv, hd)
-    q = rope(q, cos, sin)
-    kk = rope(kk, cos, sin)
+    q, kk, vv = _attn_qkv(x, bp, cos, sin, cfg)
     # GQA: repeat KV heads to match query heads (kernel stays head-uniform)
     if g > 1:
         kk = jnp.repeat(kk, g, axis=2)
@@ -202,21 +233,7 @@ def _block(x, bp, cos, sin, cfg: LlamaConfig, rules, mesh):
     attn = attn.transpose(0, 2, 1, 3).reshape(B, S, Hq * hd)
     x = x + attn @ bp["wo"].astype(cfg.dtype)
 
-    h = rms_norm(x, bp["ln2_scale"])
-    if cfg.num_experts:
-        flat = h.reshape(B * S, D)
-        moe_params = {
-            "router": bp["moe_router"],
-            "w_in": bp["moe_w_in"],
-            "w_out": bp["moe_w_out"],
-        }
-        out, aux = moe_forward(moe_params, flat, _moe_cfg(cfg))
-        x = x + out.reshape(B, S, D)
-    else:
-        h2 = _swiglu(h, bp["mlp_in"], bp["mlp_out"], cfg.dtype)
-        h2 = constrain(h2, ("batch", "seq", "embed"))
-        x = x + h2
-        aux = jnp.zeros((), jnp.float32)
+    x, aux = _ffn_residual(x, bp, cfg, constrain)
     return constrain(x, ("batch", "seq", "embed")), aux
 
 
@@ -327,6 +344,111 @@ def llama_loss(
     else:
         ce = -jnp.mean(ll)
     return ce + aux
+
+
+# ----------------------------------------------------------------------------
+# KV-cached inference paths (serve/llm engine) — same contract as
+# models/gpt.py gpt_prefill/gpt_decode_step. GQA: the cache stores the
+# compact n_kv_head heads; repetition to n_head happens inside the
+# attention ops. Cache layout [n_layer, num_blocks, block_size, n_kv_head,
+# head_dim] (ops/kv_cache.py; block 0 is the garbage sink).
+# ----------------------------------------------------------------------------
+
+
+def llama_prefill(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    block_tables: jax.Array,
+    cfg: LlamaConfig,
+):
+    """Prompt pass with paged-cache writes; see gpt_prefill. RoPE runs at
+    positions 0..S-1 exactly as the full forward. Returns
+    (last-valid-token logits [B, V] f32, cache_k', cache_v')."""
+    from ray_tpu.ops.kv_cache import write_kv
+
+    B, S = tokens.shape
+    D = cfg.d_model
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    cos, sin = rope_cache(S, cfg.head_dim, cfg.rope_theta)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    valid = pos < lengths[:, None]
+
+    def body(x, xs):
+        bp, k_layer, v_layer = xs
+        q, kk, vv = _attn_qkv(x, bp, cos, sin, cfg)
+        k_layer, v_layer = write_kv(
+            k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
+        )
+        # mha_reference repeats GQA kv heads internally
+        attn = mha_reference(
+            q.transpose(0, 2, 1, 3),
+            kk.transpose(0, 2, 1, 3),
+            vv.transpose(0, 2, 1, 3),
+            causal=True,
+        )
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
+        x = x + attn @ bp["wo"].astype(cfg.dtype)
+        x, _ = _ffn_residual(x, bp, cfg)
+        return x, (k_layer, v_layer)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache_k, cache_v)
+    )
+    h = rms_norm(x, params["ln_f_scale"])
+    h_last = h[jnp.arange(B), lengths - 1]  # [B, D]
+    logits = jnp.einsum(
+        "bd,dv->bv", h_last.astype(cfg.dtype),
+        params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, cache_k, cache_v
+
+
+def llama_decode_step(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+    block_tables: jax.Array,
+    cfg: LlamaConfig,
+):
+    """One incremental decode step; see gpt_decode_step. RoPE is applied at
+    the TRUE sequence position via the `positions` arg of ops/layers.rope.
+    Returns (next-token logits [B, V] f32, cache_k', cache_v')."""
+    from ray_tpu.ops.kv_cache import paged_attention, write_kv
+
+    B = tokens.shape[0]
+    D = cfg.d_model
+    x = params["wte"].astype(cfg.dtype)[tokens][:, None, :]  # [B, 1, D]
+    cos, sin = rope_cache(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    pos2d = positions[:, None]  # [B, 1] — rope indexes tables per row
+
+    def body(x, xs):
+        bp, k_layer, v_layer = xs
+        q, kk, vv = _attn_qkv(x, bp, cos, sin, cfg, positions=pos2d)
+        k_layer, v_layer = write_kv(
+            k_layer, v_layer, kk[:, 0], vv[:, 0], positions, block_tables
+        )
+        attn = paged_attention(
+            q[:, 0], k_layer, v_layer, block_tables, positions
+        )  # GQA handled inside (cache holds n_kv_head heads)
+        x = x + attn.reshape(B, 1, D) @ bp["wo"].astype(cfg.dtype)
+        x, _ = _ffn_residual(x, bp, cfg)
+        return x, (k_layer, v_layer)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache_k, cache_v)
+    )
+    h = rms_norm(x[:, 0], params["ln_f_scale"])
+    logits = jnp.einsum(
+        "bd,dv->bv", h.astype(cfg.dtype), params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, cache_k, cache_v
 
 
 def llama_num_params(cfg: LlamaConfig) -> int:

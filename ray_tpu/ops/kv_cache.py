@@ -1,0 +1,123 @@
+"""Paged KV-cache array primitives: block-granular write / gather / attend.
+
+The serving-side counterpart of ops/attention.py. A paged cache stores one
+layer's keys/values as fixed-size physical blocks
+
+    k_layer, v_layer: [num_blocks, block_size, n_kv_head, head_dim]
+
+and each sequence owns a BLOCK TABLE — logical position p of sequence b
+lives at (block_tables[b, p // block_size], p % block_size). Block tables
+are dense int32 arrays padded with block 0, which is reserved as a garbage
+sink: every out-of-range or padding write is redirected there, so the
+scatter/gather ops below are mask-free and shape-static (XLA-friendly — no
+dynamic shapes, bounded compile cache). Host-side block accounting (the
+allocator, free lists, reuse) lives in serve/llm/kv_cache.py; these
+functions are pure array ops so the model decode paths (models/gpt.py,
+models/llama.py) can use them without depending on the serve layer.
+
+Attention here is the XLA formulation (gather blocks, mask, softmax) — the
+decode op is bandwidth-bound at [B, T] scale where a Pallas kernel has
+nothing to fuse away on CPU; a block-parallel TPU kernel is a later
+optimization with the same call signature.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def physical_slots(
+    positions: jax.Array, block_tables: jax.Array, block_size: int
+) -> tuple[jax.Array, jax.Array]:
+    """Logical positions -> (physical block id, slot within block).
+
+    positions: [B] or [B, S] int32; block_tables: [B, NB] int32. Positions
+    outside the table range are clamped onto block 0 by the caller's
+    masking; here indices are clamped so gathers stay in bounds.
+    """
+    idx = positions // block_size
+    slot = positions % block_size
+    idx = jnp.clip(idx, 0, block_tables.shape[1] - 1)
+    if positions.ndim == 1:
+        blk = jnp.take_along_axis(block_tables, idx[:, None], axis=1)[:, 0]
+    else:
+        blk = jnp.take_along_axis(block_tables, idx, axis=1)
+    return blk, slot
+
+
+def write_kv(
+    k_layer: jax.Array,
+    v_layer: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    positions: jax.Array,
+    block_tables: jax.Array,
+    *,
+    valid: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter new keys/values into a layer's paged cache.
+
+    k, v: [B, H_kv, hd] (decode: one token per sequence, positions [B]) or
+    [B, S, H_kv, hd] (prefill: positions [B, S]). `valid` masks rows/tokens
+    that are padding — their writes are redirected to the reserved garbage
+    block 0, slot 0, keeping the scatter shape-static.
+    """
+    block_size = k_layer.shape[1]
+    blk, slot = physical_slots(positions, block_tables, block_size)
+    if valid is not None:
+        blk = jnp.where(valid, blk, 0)
+        slot = jnp.where(valid, slot, 0)
+    k_layer = k_layer.at[blk, slot].set(k.astype(k_layer.dtype))
+    v_layer = v_layer.at[blk, slot].set(v.astype(v_layer.dtype))
+    return k_layer, v_layer
+
+
+def gather_kv(
+    k_layer: jax.Array, v_layer: jax.Array, block_tables: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Materialize each sequence's cached context in position order:
+    [B, NB * block_size, H_kv, hd]. Unallocated table entries point at the
+    garbage block; the caller masks those positions."""
+    B, NB = block_tables.shape
+    _, Bs, H, hd = k_layer.shape
+    keys = k_layer[block_tables].reshape(B, NB * Bs, H, hd)
+    values = v_layer[block_tables].reshape(B, NB * Bs, H, hd)
+    return keys, values
+
+
+def paged_attention(
+    q: jax.Array,
+    k_layer: jax.Array,
+    v_layer: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: float | None = None,
+) -> jax.Array:
+    """Single-token decode attention over a paged cache.
+
+    q: [B, H_q, hd] (the current token's query, AFTER its own k/v were
+    written, so the mask `t <= position` includes self-attention).
+    Returns [B, H_q, hd] in q.dtype. GQA: H_q may be a multiple of the
+    cache's H_kv; kv heads are repeated (same policy as ops/attention.py).
+    """
+    B, Hq, hd = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    keys, values = gather_kv(k_layer, v_layer, block_tables)  # [B, T, Hkv, hd]
+    Hkv = keys.shape[2]
+    if Hq != Hkv:
+        rep = Hq // Hkv
+        keys = jnp.repeat(keys, rep, axis=2)
+        values = jnp.repeat(values, rep, axis=2)
+    logits = jnp.einsum(
+        "bhd,bthd->bht", q, keys, preferred_element_type=jnp.float32
+    ) * scale
+    T = keys.shape[1]
+    mask = jnp.arange(T, dtype=positions.dtype)[None, :] <= positions[:, None]
+    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(values.dtype)
+    return jnp.einsum("bht,bthd->bhd", probs, values).astype(q.dtype)

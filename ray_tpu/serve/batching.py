@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Callable
 
+from ray_tpu.serve._shapes import pad_to_bucket  # noqa: F401 — re-export;
+# the one shared padding rule (also used by serve/llm/engine.py)
 from ray_tpu.serve.config import BatchConfig
 
 _BATCH_ATTR = "__rt_serve_batch__"
@@ -48,11 +50,3 @@ def batch(
 
 def get_batch_config(func) -> BatchConfig | None:
     return getattr(func, _BATCH_ATTR, None)
-
-
-def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
-    """Smallest bucket >= n (last bucket if none fits)."""
-    for b in buckets:
-        if n <= b:
-            return b
-    return buckets[-1]

@@ -1,0 +1,28 @@
+"""ray_tpu.serve.llm — continuous-batching LLM inference on the Serve layer.
+
+The flagship serving workload (ROADMAP north star: token streaming to
+millions of users): a vLLM-style engine — paged KV cache + prefill/decode
+interleaving — built TPU-first, meaning every jitted shape is drawn from a
+closed bucket set so the XLA compile cache stays bounded (arxiv
+2011.03641; SURVEY.md §7). Pieces:
+
+- kv_cache.py — block allocator + preallocated cache arrays + block tables
+- decode.py   — jitted prefill / single-token decode per model family
+- engine.py   — the continuous-batching scheduler (admission, join/evict)
+- api.py      — LLMDeployment: the engine as a streaming Serve deployment
+
+See docs/SERVING_LLM.md for the design.
+"""
+from ray_tpu.serve.llm.api import LLMDeployment, build_llm_app
+from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
+
+__all__ = [
+    "EngineConfig",
+    "KVCacheConfig",
+    "LLMDeployment",
+    "LLMEngine",
+    "PagedKVCache",
+    "SamplingParams",
+    "build_llm_app",
+]

@@ -1,0 +1,80 @@
+"""LLMDeployment — the engine wrapped as a streaming Serve deployment.
+
+One engine per replica; each HTTP/gRPC/handle call becomes one engine
+request, and because the replica runs up to max_ongoing_requests method
+threads concurrently (serve/replica.py), concurrent callers' sequences
+CONTINUOUSLY BATCH inside the shared engine — the scheduler interleaves
+them at the decode-step level, not the request level. Tokens stream out
+through every existing ingress: the DeploymentHandle generator path, HTTP
+server-sent events, and the gRPC server-streaming RPC (all three are
+exercised by examples/serve_streaming_llm.py).
+
+Prompts are token-id lists, or strings encoded with the built-in
+byte-level tokenizer (token = UTF-8 byte value; any vocab >= 256 works) —
+a real BPE vocabulary plugs in by passing token ids directly.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.serve.deployment import Application, deployment
+from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, SamplingParams
+
+
+def encode_text(prompt: str, vocab_size: int) -> list[int]:
+    """Byte-level encoding: one token per UTF-8 byte (folded into the
+    vocab for the tiny test configs)."""
+    return [b % vocab_size for b in prompt.encode("utf-8")]
+
+
+def decode_token(token: int) -> str:
+    """Inverse of encode_text for printable bytes; empty otherwise."""
+    return chr(token) if 32 <= token < 127 else ""
+
+
+@deployment(max_ongoing_requests=8)
+class LLMDeployment:
+    """Streaming LLM deployment. Bind with an EngineConfig (or dict of its
+    fields): ``serve.run(LLMDeployment.bind(EngineConfig(...)))``."""
+
+    def __init__(self, engine_config: EngineConfig | dict | None = None):
+        if isinstance(engine_config, dict):
+            engine_config = EngineConfig(**engine_config)
+        self.engine = LLMEngine(engine_config)
+
+    def __call__(self, payload: dict | None):
+        """Generator: one chunk per generated token.
+
+        payload: {"prompt": str | [int], "max_new_tokens"?, "temperature"?,
+        "top_k"?, "seed"?}. Chunks: {"token": id, "index": i, "text": str}.
+        """
+        payload = payload or {}
+        prompt = payload.get("prompt", "")
+        if isinstance(prompt, str):
+            prompt = encode_text(prompt, self.engine.model_cfg.vocab_size)
+        sampling = SamplingParams(
+            max_new_tokens=int(payload.get("max_new_tokens", 16)),
+            temperature=float(payload.get("temperature", 0.0)),
+            top_k=int(payload.get("top_k", 0)),
+            seed=int(payload.get("seed", 0)),
+        )
+        stream = self.engine.submit(prompt, sampling)
+        for i, tok in enumerate(stream):
+            yield {"token": int(tok), "index": i, "text": decode_token(tok)}
+
+    def stats(self) -> dict:
+        """Engine introspection (unary method — callable via handle)."""
+        return self.engine.stats()
+
+
+def build_llm_app(
+    engine_config: EngineConfig | dict | None = None,
+    **deployment_options: Any,
+) -> Application:
+    """Convenience: ``serve.run(build_llm_app(EngineConfig(...)))``.
+    ``deployment_options`` forward to ``.options(...)`` (num_replicas,
+    ray_actor_options for TPU chips, ...)."""
+    dep = LLMDeployment
+    if deployment_options:
+        dep = dep.options(**deployment_options)
+    return dep.bind(engine_config)

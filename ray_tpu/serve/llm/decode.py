@@ -1,0 +1,102 @@
+"""Jitted incremental forwards per model family + compile-cache tracking.
+
+One `DecodeFns` per engine: it binds the (static) model config into the
+family's prefill / decode-step functions (models/gpt.py, models/llama.py),
+jits them once, and records every distinct input-shape signature it is
+called with. Because jit caches by shape, the signature set size IS the
+number of compiled programs — the engine exposes it so tests (and ops
+dashboards) can assert the bucketing keeps it bounded.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+
+def _gpt_fns(model_cfg):
+    from ray_tpu.models.gpt import gpt_decode_step, gpt_init, gpt_prefill
+
+    return gpt_init, gpt_prefill, gpt_decode_step
+
+
+def _llama_fns(model_cfg):
+    from ray_tpu.models.llama import (
+        llama_decode_step,
+        llama_init,
+        llama_prefill,
+    )
+
+    return llama_init, llama_prefill, llama_decode_step
+
+
+FAMILIES: dict[str, Callable] = {"gpt": _gpt_fns, "llama": _llama_fns}
+
+# Process-wide jit cache: jax.jit memoizes traces per *wrapper*, so two
+# engines over the same (family, config) — e.g. several replicas colocated
+# in one worker, or a test suite constructing many engines — must share
+# one wrapper each for prefill/decode or every engine re-compiles every
+# bucket shape from scratch. Configs are frozen dataclasses => hashable.
+_jit_cache: dict[tuple, tuple] = {}
+
+
+def _jitted(family: str, model_cfg):
+    key = (family, model_cfg)
+    hit = _jit_cache.get(key)
+    if hit is None:
+        import jax
+
+        init, prefill_fn, decode_fn = FAMILIES[family](model_cfg)
+        hit = (
+            init,
+            jax.jit(functools.partial(prefill_fn, cfg=model_cfg)),
+            jax.jit(functools.partial(decode_fn, cfg=model_cfg)),
+        )
+        _jit_cache[key] = hit
+    return hit
+
+
+class DecodeFns:
+    """prefill(params, cache_k, cache_v, tokens, lengths, block_tables)
+    and decode(params, cache_k, cache_v, tokens, positions, block_tables),
+    jitted with the model config closed over as a static value. Compiled
+    programs are shared process-wide per (family, config); the signature
+    set below is per-instance, so each engine reports the shapes IT
+    exercised."""
+
+    def __init__(self, family: str, model_cfg):
+        if family not in FAMILIES:
+            raise ValueError(
+                f"unknown model family {family!r}; expected one of "
+                f"{sorted(FAMILIES)}"
+            )
+        self.family = family
+        self.model_cfg = model_cfg
+        self.init, self._prefill, self._decode = _jitted(family, model_cfg)
+        self._signatures: set[tuple] = set()
+
+    def prefill(self, params, cache_k, cache_v, tokens, lengths, block_tables):
+        self._signatures.add(
+            ("prefill", tuple(tokens.shape), tuple(block_tables.shape))
+        )
+        return self._prefill(
+            params, cache_k, cache_v, tokens, lengths, block_tables
+        )
+
+    def decode(self, params, cache_k, cache_v, tokens, positions, block_tables):
+        self._signatures.add(
+            ("decode", tuple(tokens.shape), tuple(block_tables.shape))
+        )
+        return self._decode(
+            params, cache_k, cache_v, tokens, positions, block_tables
+        )
+
+    @property
+    def num_compiled_shapes(self) -> int:
+        """Distinct (kind, shape) signatures seen — each is one XLA
+        compile. The bucketed scheduler keeps this at
+        O(|batch_buckets| * |length_buckets|) regardless of traffic."""
+        return len(self._signatures)
+
+    @property
+    def signatures(self) -> frozenset:
+        return frozenset(self._signatures)

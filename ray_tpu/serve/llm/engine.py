@@ -1,0 +1,462 @@
+"""Continuous-batching scheduler: admission, prefill/decode interleave,
+per-step join/evict, bucketed shapes.
+
+The loop is the Orca/vLLM iteration-level scheduler: every step is EITHER
+one batched prefill (admitting waiting requests) or one batched decode
+step over all running sequences — new requests join the decode batch at
+the next step after their prefill, finished sequences leave it the step
+they complete, and their KV blocks return to the pool immediately.
+
+TPU-first constraint: every jitted call's shape is drawn from a closed
+set. Batch sizes pad to ``batch_buckets`` and token/context lengths to
+``length_buckets`` (serve/_shapes.py pad_to_bucket — the same rule the
+@serve.batch router uses), so total compiled programs are bounded by
+2 * |batch_buckets| * |length_buckets| no matter the traffic mix
+(arxiv 2011.03641: static-shape batching to stay inside the compile
+cache). `DecodeFns.num_compiled_shapes` reports the realized count.
+
+Sampling runs on host (numpy) per request — greedy, temperature, top-k —
+with a per-request RNG so a sequence's output is identical whether it ran
+solo or continuously batched with arbitrary neighbors.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from ray_tpu.serve._shapes import pad_to_bucket, pow2_buckets
+from ray_tpu.serve.llm.decode import DecodeFns
+from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
+from ray_tpu.util import metrics
+
+_DONE = object()  # stream sentinel
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    max_new_tokens: int = 16
+    temperature: float = 0.0  # <= 0 -> greedy
+    top_k: int = 0            # 0 -> full distribution
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    model: str = "llama"          # gpt | llama (decode.py FAMILIES)
+    model_config: Any = None      # GPTConfig/LlamaConfig; None -> .tiny()
+    block_size: int = 16
+    num_blocks: int = 64
+    max_batch_size: int = 8       # max concurrently-running sequences
+    max_prefill_batch: int = 4    # max admissions coalesced into one prefill
+    batch_buckets: tuple[int, ...] | None = None   # None -> pow2 ladder
+    length_buckets: tuple[int, ...] | None = None  # None -> pow2 ladder
+    eos_id: int | None = None
+    seed: int = 0                 # param init seed (when params not given)
+
+
+class TokenStream:
+    """Iterator over one request's generated token ids, delivered as the
+    engine produces them (blocks between tokens; ends at completion)."""
+
+    def __init__(self, request: "_Request"):
+        self._request = request
+
+    @property
+    def request_id(self):
+        return self._request.id
+
+    @property
+    def done(self) -> bool:
+        return self._request.done
+
+    def __iter__(self):
+        while True:
+            item = self._request.out.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+
+class _Request:
+    __slots__ = (
+        "id", "prompt", "sampling", "out", "generated", "rng",
+        "reserved_blocks", "done",
+    )
+
+    def __init__(self, req_id, prompt, sampling: SamplingParams):
+        self.id = req_id
+        self.prompt = list(prompt)
+        self.sampling = sampling
+        self.out: queue.Queue = queue.Queue()
+        self.generated: list[int] = []
+        self.rng = np.random.default_rng(sampling.seed)
+        self.reserved_blocks = 0
+        self.done = False
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.generated)
+
+
+def _sample(logits: np.ndarray, sp: SamplingParams, rng) -> int:
+    """Host-side sampling from one row of f32 logits."""
+    if sp.temperature <= 0.0:
+        return int(np.argmax(logits))
+    l = logits.astype(np.float64) / sp.temperature
+    if sp.top_k > 0 and sp.top_k < l.shape[-1]:
+        kth = np.partition(l, -sp.top_k)[-sp.top_k]
+        l = np.where(l < kth, -np.inf, l)
+    l = l - l.max()
+    p = np.exp(l)
+    p /= p.sum()
+    return int(rng.choice(l.shape[-1], p=p))
+
+
+class LLMEngine:
+    """Continuous-batching inference engine over a paged KV cache.
+
+    ``auto_step=True`` (the serving mode) runs the scheduler on a
+    background thread; ``auto_step=False`` lets tests drive ``step()``
+    deterministically. Only one thread may step at a time — all scheduler
+    and cache state is guarded by one lock.
+    """
+
+    def __init__(
+        self,
+        cfg: EngineConfig | None = None,
+        *,
+        params: dict | None = None,
+        auto_step: bool = True,
+        **overrides,
+    ):
+        import jax
+
+        if cfg is None:
+            cfg = EngineConfig(**overrides)
+        elif overrides:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, **overrides)
+        model_cfg = cfg.model_config
+        if model_cfg is None:
+            if cfg.model == "gpt":
+                from ray_tpu.models.gpt import GPTConfig
+
+                model_cfg = GPTConfig.tiny()
+            else:
+                from ray_tpu.models.llama import LlamaConfig
+
+                model_cfg = LlamaConfig.tiny()
+        self.cfg = cfg
+        self.model_cfg = model_cfg
+        self.fns = DecodeFns(cfg.model, model_cfg)
+        self.params = (
+            params
+            if params is not None
+            else self.fns.init(jax.random.PRNGKey(cfg.seed), model_cfg)
+        )
+        n_kv = getattr(model_cfg, "n_kv_head", model_cfg.n_head)
+        self.cache = PagedKVCache(
+            KVCacheConfig(
+                n_layer=model_cfg.n_layer,
+                n_kv_head=n_kv,
+                head_dim=model_cfg.head_dim,
+                num_blocks=cfg.num_blocks,
+                block_size=cfg.block_size,
+                dtype=model_cfg.dtype,
+            )
+        )
+        self._batch_buckets = cfg.batch_buckets or pow2_buckets(
+            1, cfg.max_batch_size
+        )
+        self._length_buckets = cfg.length_buckets or pow2_buckets(
+            cfg.block_size, model_cfg.max_seq_len
+        )
+        for b in self._length_buckets:
+            if b % cfg.block_size:
+                raise ValueError(
+                    f"length bucket {b} is not a multiple of "
+                    f"block_size={cfg.block_size}"
+                )
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._waiting: deque[_Request] = deque()
+        self._running: list[_Request] = []
+        self._next_id = 0
+        self._auto_step = auto_step
+        self._thread: threading.Thread | None = None
+        self._stopped = False
+
+        self._m_tokens = metrics.counter(
+            "llm_engine_tokens_generated",
+            "Tokens generated by the serve/llm engine",
+        )
+        self._m_queue = metrics.gauge(
+            "llm_engine_queue_depth", "Requests waiting for admission"
+        )
+        self._m_util = metrics.gauge(
+            "llm_engine_kv_block_utilization",
+            "Fraction of usable KV blocks allocated",
+        )
+        self._m_latency = metrics.histogram(
+            "llm_engine_step_latency_seconds",
+            "Engine step latency by kind (prefill/decode)",
+            boundaries=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0),
+            tag_keys=("kind",),
+        )
+
+    # ---------------- public API ----------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        sampling: SamplingParams | None = None,
+        **sampling_overrides,
+    ) -> TokenStream:
+        """Enqueue one request; returns a stream of generated token ids."""
+        if sampling is None:
+            sampling = SamplingParams(**sampling_overrides)
+        elif sampling_overrides:
+            import dataclasses
+
+            sampling = dataclasses.replace(sampling, **sampling_overrides)
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must contain at least one token")
+        total = len(prompt) + sampling.max_new_tokens
+        if total > self.model_cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({sampling.max_new_tokens}) exceeds model max_seq_len "
+                f"{self.model_cfg.max_seq_len}"
+            )
+        if self.cache.cfg.blocks_for(total) > self.cache.cfg.usable_blocks:
+            raise ValueError(
+                f"request needs {self.cache.cfg.blocks_for(total)} KV blocks "
+                f"but the pool only has {self.cache.cfg.usable_blocks}"
+            )
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("engine is shut down")
+            req = _Request(self._next_id, prompt, sampling)
+            self._next_id += 1
+            self._waiting.append(req)
+            self._m_queue.set(len(self._waiting))
+            self._work.notify_all()
+        if self._auto_step:
+            self._ensure_thread()
+        return TokenStream(req)
+
+    def generate(
+        self,
+        prompt: Sequence[int],
+        sampling: SamplingParams | None = None,
+        **sampling_overrides,
+    ) -> list[int]:
+        """Synchronous convenience: submit and collect all tokens."""
+        stream = self.submit(prompt, sampling, **sampling_overrides)
+        if not self._auto_step:
+            while not stream.done:
+                if not self.step():
+                    break  # pragma: no cover — queue drained early
+        return list(stream)
+
+    def step(self) -> bool:
+        """One scheduler iteration: a batched prefill if any request can be
+        admitted, else a batched decode step. Returns False when idle."""
+        with self._lock:
+            admitted = self._admit_locked()
+            if admitted:
+                self._prefill_locked(admitted)
+                return True
+            if self._running:
+                self._decode_locked()
+                return True
+            return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "waiting": len(self._waiting),
+                "running": len(self._running),
+                "kv_used_blocks": self.cache.used_blocks,
+                "kv_utilization": self.cache.utilization,
+                "kv_high_water_blocks": self.cache.stats.high_water_blocks,
+                "num_compiled_shapes": self.fns.num_compiled_shapes,
+            }
+
+    @property
+    def num_compiled_shapes(self) -> int:
+        return self.fns.num_compiled_shapes
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stopped = True
+            for r in list(self._waiting) + self._running:
+                if not r.done:
+                    r.done = True
+                    r.out.put(_DONE)
+            self._waiting.clear()
+            self._running.clear()
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ---------------- scheduler internals (lock held) ----------------
+
+    def _admit_locked(self) -> list[_Request]:
+        admitted: list[_Request] = []
+        while (
+            self._waiting
+            and len(self._running) + len(admitted) < self.cfg.max_batch_size
+            and len(admitted) < self.cfg.max_prefill_batch
+        ):
+            req = self._waiting[0]
+            need = self.cache.cfg.blocks_for(
+                len(req.prompt) + req.sampling.max_new_tokens
+            )
+            if not self.cache.can_reserve(need):
+                break  # blocks free up when a running sequence completes
+            self.cache.reserve(need)
+            req.reserved_blocks = need
+            admitted.append(self._waiting.popleft())
+        if admitted:
+            self._m_queue.set(len(self._waiting))
+        return admitted
+
+    def _prefill_locked(self, admitted: list[_Request]) -> None:
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        bs = self.cfg.block_size
+        for r in admitted:
+            self.cache.allocate(r.id)
+            self.cache.ensure_capacity(r.id, len(r.prompt))
+        S = pad_to_bucket(
+            max(len(r.prompt) for r in admitted), self._length_buckets
+        )
+        B = pad_to_bucket(len(admitted), self._batch_buckets)
+        nb = S // bs
+        tokens = np.zeros((B, S), np.int32)
+        lengths = np.ones((B,), np.int32)  # padding rows: length 1
+        tables = np.zeros((B, nb), np.int32)
+        for i, r in enumerate(admitted):
+            tokens[i, : len(r.prompt)] = r.prompt
+            lengths[i] = len(r.prompt)
+            tables[i] = self.cache.block_table(r.id, nb)
+        logits, self.cache.k, self.cache.v = self.fns.prefill(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(tables),
+        )
+        logits = np.asarray(logits, np.float32)
+        for i, r in enumerate(admitted):
+            self._emit_locked(r, logits[i])
+            if not r.done:
+                self._running.append(r)
+        self._m_util.set(self.cache.utilization)
+        self._m_latency.observe(
+            time.perf_counter() - t0, tags={"kind": "prefill"}
+        )
+
+    def _decode_locked(self) -> None:
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        bs = self.cfg.block_size
+        batch = list(self._running)
+        for r in batch:
+            self.cache.ensure_capacity(r.id, r.total_len)
+        B = pad_to_bucket(len(batch), self._batch_buckets)
+        ctx = pad_to_bucket(
+            max(r.total_len for r in batch), self._length_buckets
+        )
+        nb = ctx // bs
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        tables = np.zeros((B, nb), np.int32)
+        for i, r in enumerate(batch):
+            tokens[i] = r.generated[-1] if r.generated else r.prompt[-1]
+            positions[i] = r.total_len - 1
+            tables[i] = self.cache.block_table(r.id, nb)
+        logits, self.cache.k, self.cache.v = self.fns.decode(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+        )
+        logits = np.asarray(logits, np.float32)
+        for i, r in enumerate(batch):
+            self._emit_locked(r, logits[i])
+        self._running = [r for r in self._running if not r.done]
+        self._m_util.set(self.cache.utilization)
+        self._m_latency.observe(
+            time.perf_counter() - t0, tags={"kind": "decode"}
+        )
+
+    def _emit_locked(self, r: _Request, logits_row: np.ndarray) -> None:
+        tok = _sample(logits_row, r.sampling, r.rng)
+        r.generated.append(tok)
+        r.out.put(tok)
+        self._m_tokens.inc()
+        if (
+            len(r.generated) >= r.sampling.max_new_tokens
+            or (self.cfg.eos_id is not None and tok == self.cfg.eos_id)
+        ):
+            self._complete_locked(r)
+
+    def _complete_locked(self, r: _Request) -> None:
+        leftover = r.reserved_blocks - self.cache.num_allocated(r.id)
+        self.cache.free(r.id)
+        if leftover > 0:
+            self.cache.release_reservation(leftover)
+        r.done = True
+        r.out.put(_DONE)
+        self._work.notify_all()  # freed blocks may unblock admissions
+
+    # ---------------- background stepping ----------------
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is not None or self._stopped:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name="llm-engine-step", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+            try:
+                progressed = self.step()
+            except Exception as e:  # noqa: BLE001 — fan out to all streams
+                with self._lock:
+                    for r in list(self._waiting) + self._running:
+                        if not r.done:
+                            r.done = True
+                            r.out.put(e)
+                            r.out.put(_DONE)
+                    self._waiting.clear()
+                    self._running.clear()
+                continue
+            if not progressed:
+                with self._work:
+                    if (
+                        not self._stopped
+                        and not self._waiting
+                        and not self._running
+                    ):
+                        self._work.wait(timeout=0.05)

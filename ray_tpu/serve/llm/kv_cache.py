@@ -1,0 +1,153 @@
+"""Paged KV-cache manager: preallocated block pool + per-sequence tables.
+
+vLLM-style paging (PAPERS.md: serving Gemma on Cloud TPU uses the same
+structure): the cache is ONE preallocated array pair per model —
+
+    k, v: [n_layer, num_blocks, block_size, n_kv_head, head_dim]
+
+— and sequences own logical-position-ordered lists of physical block ids.
+Fragmentation-free growth (append one block at a time), O(1) free, and
+blocks returned on sequence completion are immediately reusable, so the
+steady-state footprint is set by CONCURRENT tokens, not total traffic.
+
+Block 0 is reserved as the garbage sink: padding rows and masked writes
+are redirected there (ops/kv_cache.py), which keeps every jitted scatter
+shape-static. The allocator therefore hands out blocks [1, num_blocks).
+
+Admission control is reservation-based: the engine reserves a sequence's
+WORST-CASE block count (prompt + max_new_tokens) before prefill, so a
+running sequence can never fail a mid-flight append — the simple analog of
+vLLM's preemption machinery, traded for a little capacity headroom
+(docs/SERVING_LLM.md discusses the trade).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class KVCacheConfig:
+    n_layer: int
+    n_kv_head: int
+    head_dim: int
+    num_blocks: int = 64
+    block_size: int = 16
+    dtype: Any = None  # jnp dtype; None -> bfloat16
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # block 0 is the garbage sink
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)  # ceil
+
+
+@dataclass
+class CacheStats:
+    high_water_blocks: int = 0
+    allocated_total: int = 0
+    freed_total: int = 0
+    tables: dict = field(default_factory=dict)
+
+
+class PagedKVCache:
+    """Host-side block accounting + the device cache arrays.
+
+    Not thread-safe by itself — the engine serializes all access under its
+    scheduler lock (one stepper at a time).
+    """
+
+    def __init__(self, cfg: KVCacheConfig):
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        dtype = cfg.dtype if cfg.dtype is not None else jnp.bfloat16
+        shape = (
+            cfg.n_layer, cfg.num_blocks, cfg.block_size,
+            cfg.n_kv_head, cfg.head_dim,
+        )
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # LIFO free list: a just-freed (cache-warm) block is reused first
+        self._free: list[int] = list(range(1, cfg.num_blocks))
+        self._tables: dict[Any, list[int]] = {}
+        self._reserved = 0
+        self.stats = CacheStats()
+
+    # ---------------- reservation (admission control) ----------------
+
+    def can_reserve(self, n_blocks: int) -> bool:
+        return n_blocks <= len(self._free) - self._reserved
+
+    def reserve(self, n_blocks: int) -> None:
+        if not self.can_reserve(n_blocks):
+            raise RuntimeError(
+                f"cannot reserve {n_blocks} blocks: "
+                f"{len(self._free)} free, {self._reserved} already reserved"
+            )
+        self._reserved += n_blocks
+
+    def release_reservation(self, n_blocks: int) -> None:
+        self._reserved -= n_blocks
+        assert self._reserved >= 0, "reservation accounting went negative"
+
+    # ---------------- allocate / append / free ----------------
+
+    def allocate(self, seq_id) -> None:
+        """Register a sequence with an empty block table."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        self._tables[seq_id] = []
+
+    def ensure_capacity(self, seq_id, num_tokens: int, *, reserved=True):
+        """Append blocks until the sequence can hold ``num_tokens``.
+        Draws from this sequence's reservation when ``reserved``."""
+        table = self._tables[seq_id]
+        while len(table) * self.cfg.block_size < num_tokens:
+            if not self._free:
+                raise RuntimeError(
+                    "KV block pool exhausted — reservation accounting bug"
+                )
+            table.append(self._free.pop())
+            if reserved:
+                self._reserved -= 1
+            self.stats.allocated_total += 1
+        self.stats.high_water_blocks = max(
+            self.stats.high_water_blocks, self.used_blocks
+        )
+
+    def free(self, seq_id) -> int:
+        """Return a finished sequence's blocks to the pool; -> count."""
+        table = self._tables.pop(seq_id)
+        self._free.extend(reversed(table))  # LIFO: newest block reused first
+        self.stats.freed_total += len(table)
+        return len(table)
+
+    # ---------------- views ----------------
+
+    @property
+    def used_blocks(self) -> int:
+        return self.cfg.usable_blocks - len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        return self.used_blocks / max(1, self.cfg.usable_blocks)
+
+    def block_table(self, seq_id, pad_to: int) -> np.ndarray:
+        """[pad_to] int32 table, unallocated tail padded with garbage
+        block 0 (those positions are always masked)."""
+        table = self._tables[seq_id]
+        if len(table) > pad_to:
+            raise ValueError(
+                f"sequence {seq_id!r} holds {len(table)} blocks, "
+                f"table was asked to fit in {pad_to}"
+            )
+        out = np.zeros((pad_to,), np.int32)
+        out[: len(table)] = table
+        return out
+
+    def num_allocated(self, seq_id) -> int:
+        return len(self._tables[seq_id])

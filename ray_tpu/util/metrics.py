@@ -90,6 +90,47 @@ class Histogram(_Metric):
         h.observe(value)
 
 
+# Idempotent named-metric factories: prometheus_client raises on duplicate
+# registration, but library-internal metrics (e.g. the serve/llm engine,
+# which may be constructed several times in one process) want one shared
+# instrument per name. Keyed on name; kind mismatches fail loudly.
+_named: dict[str, _Metric] = {}
+_named_lock = threading.Lock()
+
+
+def _get_named(cls, name: str, description: str, tag_keys, **kwargs):
+    with _named_lock:
+        m = _named.get(name)
+        if m is None:
+            m = cls(name, description, tag_keys=tag_keys, **kwargs)
+            _named[name] = m
+        elif type(m) is not cls:
+            raise TypeError(
+                f"metric {name!r} already registered as {type(m).__name__}, "
+                f"requested {cls.__name__}"
+            )
+        return m
+
+
+def counter(name: str, description: str = "", tag_keys=()) -> Counter:
+    """Get-or-create a process-wide Counter by name."""
+    return _get_named(Counter, name, description, tag_keys)
+
+
+def gauge(name: str, description: str = "", tag_keys=()) -> Gauge:
+    """Get-or-create a process-wide Gauge by name."""
+    return _get_named(Gauge, name, description, tag_keys)
+
+
+def histogram(
+    name: str, description: str = "", boundaries=(), tag_keys=()
+) -> Histogram:
+    """Get-or-create a process-wide Histogram by name."""
+    return _get_named(
+        Histogram, name, description, tag_keys, boundaries=boundaries
+    )
+
+
 def start_metrics_server(port: int = 9090) -> None:
     """Expose the registry on http://0.0.0.0:port/metrics (Prometheus
     scrape target — the analog of the reference's per-node metrics agent)."""

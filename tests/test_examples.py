@@ -41,6 +41,7 @@ def test_example_train_gpt_mesh(ray_start, jax_cpu):
 
 def test_example_serve_streaming_llm(ray_start):
     tokens, sse, rpc = _load("serve_streaming_llm").main()
-    assert tokens == ["echo", "hello"]
-    assert sse == ["echo", "world"]
-    assert rpc == ["echo", "grpc"]
+    # real engine tokens, greedy: all three ingress paths are token-exact
+    assert len(tokens) == 8 and all(isinstance(t, int) for t in tokens)
+    assert sse == tokens
+    assert rpc == tokens
