@@ -1,0 +1,175 @@
+"""Seeded fault-injection harness for fault-tolerance tests.
+
+Deterministic fault plans replace hand-rolled ``os._exit`` sprinkling:
+a plan is a list of (hook site, trigger, action) triples, and
+instrumented code calls ``chaos.fire(point, **context)`` at each site —
+a no-op unless a plan is active (reference idea: failpoints / Ray's
+``_private.test_utils`` fault injection, Podracer's routine-preemption
+framing in PAPERS.md: preemption is a first-class, *tested* state).
+
+Hook sites currently instrumented:
+
+  ``engine.step``     — top of every LLMEngine scheduler iteration
+  ``engine.prefill``  — before a batched prefill call
+  ``engine.decode``   — before a batched decode call
+  ``llm.token``       — after LLMDeployment yields one streamed chunk
+                        (context: index, resumed, tag)
+  ``handle.dispatch`` — before the router dispatches a call to a replica
+                        (context: method)
+
+Plans install either in-process (``install``, for unit tests driving an
+engine directly) or via the ``RAY_TPU_CHAOS_PLAN`` environment variable
+(JSON; worker processes inherit the environment, so a plan exported
+before ``serve.run`` reaches every replica). ``tests/conftest.py``
+exposes both paths as the ``chaos_plan`` fixture.
+
+Determinism: triggers are counters and exact-match context filters, and
+``FaultPlan.seed`` seeds any randomized action (currently jittered
+delays), so a failure schedule replays identically run to run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass
+
+ENV_VAR = "RAY_TPU_CHAOS_PLAN"
+
+
+class ChaosFault(RuntimeError):
+    """Raised by a ``raise``-action fault (simulates e.g. a jitted step
+    blowing up) and by ``drop`` via its ConnectionError subclass below."""
+
+
+class ChaosDroppedRPC(ChaosFault, ConnectionError):
+    """A ``drop``-action fault: the instrumented RPC never happened."""
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One fault: fire ``action`` at hook site ``point``.
+
+    after  — trigger on the Nth *matching* hit (1-based; 0 = first hit).
+    when   — exact-match filter on the fire() context (e.g.
+             {"index": 3, "resumed": False}); None matches every hit.
+    times  — max firings for this fault (None = unlimited).
+    arg    — action parameter: delay seconds / jitter ceiling, message.
+    """
+
+    point: str
+    action: str  # kill | raise | delay | drop
+    after: int = 0
+    when: dict | None = None
+    times: int | None = 1
+    arg: float | str | None = None
+
+
+@dataclass
+class FaultPlan:
+    seed: int = 0
+    faults: tuple = ()
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed, "faults": [asdict(f) for f in self.faults]}
+        )
+
+    @staticmethod
+    def from_json(blob: str) -> "FaultPlan":
+        raw = json.loads(blob)
+        return FaultPlan(
+            seed=int(raw.get("seed", 0)),
+            faults=tuple(Fault(**f) for f in raw.get("faults", ())),
+        )
+
+
+class _State:
+    """Per-process chaos state: the active plan + per-fault counters."""
+
+    def __init__(self, plan: FaultPlan):
+        import numpy as np
+
+        self.plan = plan
+        self.hits = [0] * len(plan.faults)    # matching-hit counts
+        self.fired = [0] * len(plan.faults)   # firings so far
+        self.rng = np.random.default_rng(plan.seed)
+        self.lock = threading.Lock()
+
+
+_installed: _State | None = None
+_env_state: _State | None = None
+_env_checked = False
+_mutex = threading.Lock()
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Activate ``plan`` in this process (overrides any env-var plan)."""
+    global _installed
+    with _mutex:
+        _installed = _State(plan)
+    return plan
+
+
+def clear() -> None:
+    """Deactivate the in-process plan (an env-var plan, if any, resumes)."""
+    global _installed, _env_state, _env_checked
+    with _mutex:
+        _installed = None
+        # re-read the env next fire(): the fixture may have unset it
+        _env_state = None
+        _env_checked = False
+
+
+def _active() -> _State | None:
+    global _env_state, _env_checked
+    if _installed is not None:
+        return _installed
+    if not _env_checked:
+        with _mutex:
+            if not _env_checked:
+                blob = os.environ.get(ENV_VAR)
+                if blob:
+                    try:
+                        _env_state = _State(FaultPlan.from_json(blob))
+                    except Exception:  # noqa: BLE001 — bad plan = no chaos
+                        _env_state = None
+                _env_checked = True
+    return _installed or _env_state
+
+
+def fire(point: str, **context) -> None:
+    """Hook-site entry: trigger any matching active faults. No-op (one
+    attribute read + one env check, once) when no plan is active."""
+    state = _active()
+    if state is None:
+        return
+    for i, f in enumerate(state.plan.faults):
+        if f.point != point:
+            continue
+        if f.when and any(context.get(k) != v for k, v in f.when.items()):
+            continue
+        with state.lock:
+            state.hits[i] += 1
+            if f.after and state.hits[i] < f.after:
+                continue
+            if f.times is not None and state.fired[i] >= f.times:
+                continue
+            state.fired[i] += 1
+        _act(f, state)
+
+
+def _act(f: Fault, state: _State) -> None:
+    if f.action == "delay":
+        base = float(f.arg or 0.1)
+        # seeded jitter keeps schedules deterministic yet non-degenerate
+        time.sleep(base if f.times == 1 else base * (0.5 + state.rng.random()))
+    elif f.action == "raise":
+        raise ChaosFault(str(f.arg or f"chaos fault at {f.point}"))
+    elif f.action == "drop":
+        raise ChaosDroppedRPC(str(f.arg or f"chaos dropped rpc at {f.point}"))
+    elif f.action == "kill":
+        os._exit(1)
+    else:
+        raise ValueError(f"unknown chaos action {f.action!r}")

@@ -46,12 +46,36 @@ class ActorDiedError(ActorError):
         super().__init__(f"actor {actor_id} died: {reason}")
 
 
+class EngineDiedError(ActorError):
+    """A serving engine failed (step raised) or wedged (step watchdog
+    fired); every in-flight stream is dead. Subclasses ActorError so
+    clients treat it exactly like replica death — the handle failover
+    path re-submits to a surviving replica."""
+
+
 class ObjectLostError(RayTpuError):
     """Object was evicted/lost and could not be reconstructed from lineage."""
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):
     """`get(timeout=...)` expired."""
+
+
+class EngineOverloadedError(RayTpuError):
+    """Admission control rejected the request: the engine's waiting queue
+    (or its worst-case KV-block budget) is full. Retryable — the HTTP
+    proxy maps this to 503 + Retry-After, the gRPC proxy to
+    RESOURCE_EXHAUSTED."""
+
+
+class RequestCancelledError(RayTpuError):
+    """The request was cancelled (client disconnect, explicit cancel(), or
+    engine shutdown) and its KV blocks were returned to the pool."""
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's deadline_s expired before generation completed; the
+    sequence was evicted and its KV blocks freed."""
 
 
 class WorkerCrashedError(RayTpuError):

@@ -22,7 +22,15 @@ from __future__ import annotations
 import json
 import threading
 import time
+import uuid
 from typing import Any
+
+from ray_tpu.exceptions import (
+    DeadlineExceededError,
+    EngineOverloadedError,
+    RequestCancelledError,
+    TaskError,
+)
 
 SERVICE_NAME = "ray_tpu.serve.ServeAPI"
 CALL_METHOD = f"/{SERVICE_NAME}/Call"
@@ -44,6 +52,28 @@ def _decode(request: bytes) -> Any:
         return json.loads(request)
     except (json.JSONDecodeError, UnicodeDecodeError):
         return request
+
+
+def _unwrap(e: BaseException) -> BaseException:
+    if isinstance(e, TaskError) and e.cause is not None:
+        return e.cause
+    return e
+
+
+def _code_for(e: BaseException):
+    """Degradation statuses (mirrors the HTTP proxy's _status_for):
+    overload -> RESOURCE_EXHAUSTED (retryable), blown deadline ->
+    DEADLINE_EXCEEDED, cancelled -> CANCELLED, else INTERNAL."""
+    import grpc
+
+    e = _unwrap(e)
+    if isinstance(e, EngineOverloadedError):
+        return grpc.StatusCode.RESOURCE_EXHAUSTED
+    if isinstance(e, DeadlineExceededError):
+        return grpc.StatusCode.DEADLINE_EXCEEDED
+    if isinstance(e, RequestCancelledError):
+        return grpc.StatusCode.CANCELLED
+    return grpc.StatusCode.INTERNAL
 
 
 class GrpcProxy:
@@ -80,6 +110,8 @@ class GrpcProxy:
         return md.get("application", "default"), md.get("method", "__call__")
 
     def _dispatch(self, request: bytes, context):
+        """-> (response, cancel) where cancel() best-effort cancels the
+        request on whichever replica serves it (None for unary calls)."""
         from ray_tpu.serve.handle import DeploymentHandle
 
         app_name, method = self._target(context)
@@ -87,9 +119,26 @@ class GrpcProxy:
         handle = DeploymentHandle(ingress, app_name).options(
             stream_chunk_timeout_s=self.options.request_timeout_s)
         payload = _decode(request)
+        cancel = None
+        if isinstance(payload, dict):
+            try:
+                streaming = method in handle.stream_methods()
+            except Exception:  # noqa: BLE001 — best-effort tag
+                streaming = False
+            if streaming:
+                payload = dict(payload)
+                payload.setdefault("request_id", uuid.uuid4().hex)
+                rid = payload["request_id"]
+
+                def cancel():
+                    threading.Thread(
+                        target=lambda: handle.broadcast("cancel", rid),
+                        daemon=True, name="serve-grpc-cancel",
+                    ).start()
+
         if method == "__call__":
-            return handle.remote(payload)
-        return getattr(handle, method).remote(payload)
+            return handle.remote(payload), cancel
+        return getattr(handle, method).remote(payload), cancel
 
     # -- rpc handlers --
 
@@ -99,7 +148,7 @@ class GrpcProxy:
         from ray_tpu.serve.handle import DeploymentResponseGenerator
 
         try:
-            response = self._dispatch(request, context)
+            response, _cancel = self._dispatch(request, context)
             if isinstance(response, DeploymentResponseGenerator):
                 # unary call on a streaming method: drain into a list.
                 # Deliberate but surprising — tell the client (the Stream
@@ -132,7 +181,7 @@ class GrpcProxy:
         except KeyError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except Exception as e:  # noqa: BLE001 — surface to the client
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            context.abort(_code_for(e), str(e))
 
     def _stream(self, request: bytes, context):
         import grpc
@@ -140,13 +189,20 @@ class GrpcProxy:
         from ray_tpu.serve.handle import DeploymentResponseGenerator
 
         try:
-            response = self._dispatch(request, context)
+            response, cancel = self._dispatch(request, context)
         except KeyError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             return
         except Exception as e:  # noqa: BLE001
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            context.abort(_code_for(e), str(e))
             return
+        finished = threading.Event()
+        if cancel is not None:
+            # fires when the RPC terminates for ANY reason; only a client
+            # cancel/disconnect leaves `finished` unset -> free the
+            # replica-side sequence instead of generating into the void
+            context.add_callback(
+                lambda: None if finished.is_set() else cancel())
         try:
             if isinstance(response, DeploymentResponseGenerator):
                 for chunk in response:
@@ -154,8 +210,10 @@ class GrpcProxy:
             else:
                 yield _encode(
                     response.result(timeout=self.options.request_timeout_s))
+            finished.set()
         except Exception as e:  # noqa: BLE001
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            finished.set()
+            context.abort(_code_for(e), str(e))
 
     # -- server lifecycle --
 

@@ -19,6 +19,7 @@ from concurrent.futures import Future
 from typing import Any
 
 import ray_tpu
+from ray_tpu._private import chaos
 
 _TABLE_REFRESH_S = 0.25
 
@@ -76,6 +77,9 @@ class DeploymentResponseGenerator:
         self._ref_gen = ref_gen
         self._on_done = on_done
         self._done = False
+        # actor id (bytes) of the replica serving this stream; set by the
+        # router at dispatch so failover can exclude the dead replica
+        self.replica_actor_id: bytes | None = None
         # per-chunk fetch budget; None = wait forever (slow LLM prefill /
         # long tool calls can legitimately exceed any fixed gap). Set via
         # handle.options(stream_chunk_timeout_s=...).
@@ -104,6 +108,91 @@ class DeploymentResponseGenerator:
     @property
     def completed_ref(self):
         return self._ref_gen.completed_ref
+
+
+def _failover_cause(e: BaseException) -> BaseException:
+    """Unwrap a TaskError to the replica-side exception for retryability
+    classification (worker.py re-raises .cause where picklable, but the
+    streaming marker path can still surface the wrapper)."""
+    from ray_tpu.exceptions import TaskError
+
+    if isinstance(e, TaskError) and e.cause is not None:
+        return e.cause
+    return e
+
+
+class ResumableStreamGenerator:
+    """A streamed call that survives replica death mid-stream.
+
+    Wraps dispatch-to-one-replica (``dispatch(payload, exclude)``): when
+    the serving replica dies (ActorError — including the engine watchdog's
+    EngineDiedError — worker crash, lost chunk, dropped connection), it
+    builds a resume payload from every chunk already delivered
+    (``resume(chunks)``), excludes the dead replica, and re-dispatches to
+    a survivor. Chunks must be dicts carrying ``index_key`` with the
+    ABSOLUTE chunk index; duplicates from the resumed stream are dropped
+    so the caller sees each index exactly once, gap-free.
+    """
+
+    def __init__(self, dispatch, payload, resume, *, index_key: str = "index",
+                 max_failovers: int = 2):
+        self._dispatch = dispatch
+        self._payload = payload
+        self._resume = resume
+        self._index_key = index_key
+        self._max_failovers = max_failovers
+        self._inner = None
+        self.chunks: list = []   # every chunk delivered to the caller
+        self.failovers = 0
+        self._exclude: set[bytes] = set()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from ray_tpu.exceptions import (
+            ActorError,
+            ObjectLostError,
+            WorkerCrashedError,
+        )
+
+        retryable = (ActorError, WorkerCrashedError, ObjectLostError,
+                     ConnectionError)
+        while True:
+            try:
+                if self._inner is None:
+                    self._inner = self._dispatch(
+                        self._payload, frozenset(self._exclude)
+                    )
+                chunk = next(self._inner)
+            except StopIteration:
+                raise
+            except BaseException as e:  # noqa: BLE001 — classify below
+                if (
+                    not isinstance(_failover_cause(e), retryable)
+                    or self.failovers >= self._max_failovers
+                ):
+                    raise
+                self.failovers += 1
+                aid = getattr(self._inner, "replica_actor_id", None)
+                if aid is not None:
+                    self._exclude.add(aid)
+                self._payload = self._resume(list(self.chunks))
+                self._inner = None
+                continue
+            idx = chunk.get(self._index_key) if isinstance(chunk, dict) else None
+            if idx is None:
+                self.chunks.append(chunk)
+                return chunk
+            if idx < len(self.chunks):
+                continue  # duplicate from the resumed stream — drop
+            if idx > len(self.chunks):
+                raise RuntimeError(
+                    f"stream gap: expected chunk {len(self.chunks)}, "
+                    f"got {idx}"
+                )
+            self.chunks.append(chunk)
+            return chunk
 
 
 class _Router:
@@ -206,12 +295,18 @@ class _Router:
             if worker.store.status(ObjectID(oid)) != "missing":
                 self._decrement(oid)
 
-    def _pick_replica(self, deadline: float):
-        """Power of two choices over tracked in-flight counts."""
+    def _pick_replica(self, deadline: float, exclude: frozenset = frozenset()):
+        """Power of two choices over tracked in-flight counts. ``exclude``
+        holds actor ids (bytes) of replicas the caller knows are dead —
+        the failover path skips them until the controller's reconcile
+        removes them from the routing table."""
         while True:
             self._refresh()
             with self._lock:
-                replicas = list(self._replicas)
+                replicas = [
+                    r for r in self._replicas
+                    if r._actor_id.binary() not in exclude
+                ]
                 if replicas:
                     if len(replicas) == 1:
                         return replicas[0]
@@ -229,8 +324,10 @@ class _Router:
     # -- call paths --
 
     def call(self, method_name: str, args: tuple, kwargs: dict,
-             options: dict | None = None) -> DeploymentResponse:
+             options: dict | None = None,
+             exclude: frozenset = frozenset()) -> DeploymentResponse:
         options = options or {}
+        chaos.fire("handle.dispatch", method=method_name)
         self._refresh()
         with self._lock:
             bc = self._batch_configs.get(method_name)
@@ -245,7 +342,7 @@ class _Router:
             )
         with self._lock:
             is_stream = method_name in self._stream_methods
-        replica = self._pick_replica(time.monotonic() + 30)
+        replica = self._pick_replica(time.monotonic() + 30, exclude)
         aid = replica._actor_id.binary()
         if is_stream:
             # generator replica method: dispatch through the streaming
@@ -257,15 +354,44 @@ class _Router:
             with self._lock:
                 self._inflight[aid] = self._inflight.get(aid, 0) + 1
                 self._outstanding[oid] = aid
-            return DeploymentResponseGenerator(
+            out = DeploymentResponseGenerator(
                 gen, on_done=lambda: self._decrement(oid),
                 chunk_timeout_s=options.get("stream_chunk_timeout_s", 120.0))
+            out.replica_actor_id = aid
+            return out
         ref = replica.rt_call.remote(method_name, args, kwargs)
         oid = ref.object_id.binary()
         with self._lock:
             self._inflight[aid] = self._inflight.get(aid, 0) + 1
             self._outstanding[oid] = aid
         return DeploymentResponse(ref=ref, on_done=lambda: self._decrement(oid))
+
+    def broadcast(self, method_name: str, args: tuple = (),
+                  kwargs: dict | None = None, timeout: float = 30.0) -> list:
+        """Dispatch a unary method to EVERY running replica and collect the
+        results (None where a replica failed). Used for operations that
+        must reach whichever replica owns some state — e.g. cancelling a
+        stream that power-of-two routing placed on an unknown replica."""
+        self._refresh(force=True)
+        with self._lock:
+            replicas = list(self._replicas)
+        refs = []
+        for replica in replicas:
+            try:
+                refs.append(replica.rt_call.remote(
+                    method_name, tuple(args), kwargs or {}))
+            except Exception:  # noqa: BLE001 — dead replica: skip it
+                refs.append(None)
+        results = []
+        for ref in refs:
+            if ref is None:
+                results.append(None)
+                continue
+            try:
+                results.append(ray_tpu.get(ref, timeout=timeout))
+            except Exception:  # noqa: BLE001 — dead replica: skip it
+                results.append(None)
+        return results
 
 class _HandleMethod:
     def __init__(self, router: _Router, method_name: str,
@@ -318,3 +444,37 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._router.call("__call__", args, kwargs,
                                  options=self._handle_options)
+
+    def stream_methods(self, force: bool = False) -> set:
+        """Names of the deployment's generator (streaming) methods
+        (cached routing table unless ``force``)."""
+        router = self._router
+        router._refresh(force=force)
+        with router._lock:
+            return set(router._stream_methods)
+
+    def broadcast(self, method_name: str, *args, **kwargs) -> list:
+        """Call a unary method on EVERY running replica; -> list of results
+        (None where a replica failed). For state that lives on an unknown
+        replica — e.g. ``handle.broadcast("cancel", request_id)`` reaches
+        whichever replica is serving the stream (cancel is idempotent)."""
+        return self._router.broadcast(method_name, args, kwargs)
+
+    def stream_with_failover(self, payload: dict, *, resume,
+                             method: str = "__call__",
+                             index_key: str = "index",
+                             max_failovers: int = 2):
+        """Stream ``method(payload)`` with mid-stream replica failover:
+        on replica death, ``resume(chunks_so_far)`` builds the re-submit
+        payload and the call is re-dispatched to a surviving replica,
+        deduplicating by ``index_key``. See serve.llm.stream_tokens for
+        the LLM resume recipe (prior_tokens + deterministic sampling)."""
+        def dispatch(p, exclude):
+            return self._router.call(method, (p,), {},
+                                     options=self._handle_options,
+                                     exclude=exclude)
+
+        return ResumableStreamGenerator(
+            dispatch, payload, resume,
+            index_key=index_key, max_failovers=max_failovers,
+        )

@@ -13,16 +13,27 @@ closed bucket set so the XLA compile cache stays bounded (arxiv
 
 See docs/SERVING_LLM.md for the design.
 """
-from ray_tpu.serve.llm.api import LLMDeployment, build_llm_app
+from ray_tpu.exceptions import (
+    DeadlineExceededError,
+    EngineDiedError,
+    EngineOverloadedError,
+    RequestCancelledError,
+)
+from ray_tpu.serve.llm.api import LLMDeployment, build_llm_app, stream_tokens
 from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, SamplingParams
 from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
 
 __all__ = [
+    "DeadlineExceededError",
     "EngineConfig",
+    "EngineDiedError",
+    "EngineOverloadedError",
     "KVCacheConfig",
     "LLMDeployment",
     "LLMEngine",
     "PagedKVCache",
+    "RequestCancelledError",
     "SamplingParams",
     "build_llm_app",
+    "stream_tokens",
 ]

@@ -12,13 +12,24 @@ exercised by examples/serve_streaming_llm.py).
 Prompts are token-id lists, or strings encoded with the built-in
 byte-level tokenizer (token = UTF-8 byte value; any vocab >= 256 works) —
 a real BPE vocabulary plugs in by passing token ids directly.
+
+Failure semantics (docs/SERVING_LLM.md): every chunk carries
+``(request_id, index)`` where ``index`` is the ABSOLUTE token position,
+so a client (``stream_tokens`` / ``DeploymentHandle.stream_with_failover``)
+can resume a stream on a surviving replica after this one dies: it
+re-submits ``prompt`` plus ``prior_tokens`` (the tokens it already has)
+and the engine re-prefills and fast-forwards the sampling RNG, making the
+resumed stream byte-identical to an uninterrupted one.
 """
 from __future__ import annotations
 
+import uuid
 from typing import Any
 
+from ray_tpu._private import chaos
 from ray_tpu.serve.deployment import Application, deployment
 from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.util import metrics
 
 
 def encode_text(prompt: str, vocab_size: int) -> list[int]:
@@ -41,30 +52,103 @@ class LLMDeployment:
         if isinstance(engine_config, dict):
             engine_config = EngineConfig(**engine_config)
         self.engine = LLMEngine(engine_config)
+        # external request_id -> engine-internal id, for cancel()
+        self._active: dict[str, Any] = {}
+        self._resumed_total = 0
+        self._m_resumed = metrics.counter(
+            "llm_requests_resumed",
+            "Streams resumed on this replica after another replica died",
+        )
 
     def __call__(self, payload: dict | None):
         """Generator: one chunk per generated token.
 
         payload: {"prompt": str | [int], "max_new_tokens"?, "temperature"?,
-        "top_k"?, "seed"?}. Chunks: {"token": id, "index": i, "text": str}.
+        "top_k"?, "seed"?, "request_id"?, "deadline_s"?, "prior_tokens"?}.
+        Chunks: {"request_id": str, "token": id, "index": i, "text": str}
+        where ``index`` is absolute — a resumed stream continues the
+        numbering of the stream it replaces.
         """
         payload = payload or {}
         prompt = payload.get("prompt", "")
         if isinstance(prompt, str):
             prompt = encode_text(prompt, self.engine.model_cfg.vocab_size)
+        prompt = [int(t) for t in prompt]
+        request_id = str(payload.get("request_id") or uuid.uuid4().hex)
+        prior = [int(t) for t in payload.get("prior_tokens") or ()]
+        max_new = int(payload.get("max_new_tokens", 16))
+        if prior:
+            self._resumed_total += 1
+            self._m_resumed.inc()
+            if len(prior) >= max_new:
+                return  # the dead replica already delivered everything
+        deadline_s = payload.get("deadline_s")
         sampling = SamplingParams(
-            max_new_tokens=int(payload.get("max_new_tokens", 16)),
+            max_new_tokens=max_new - len(prior),
             temperature=float(payload.get("temperature", 0.0)),
             top_k=int(payload.get("top_k", 0)),
             seed=int(payload.get("seed", 0)),
+            deadline_s=float(deadline_s) if deadline_s is not None else None,
+            start_index=len(prior),
         )
-        stream = self.engine.submit(prompt, sampling)
-        for i, tok in enumerate(stream):
-            yield {"token": int(tok), "index": i, "text": decode_token(tok)}
+        stream = self.engine.submit(prompt + prior, sampling)
+        self._active[request_id] = stream.request_id
+        try:
+            for i, tok in enumerate(stream):
+                index = len(prior) + i
+                yield {
+                    "request_id": request_id,
+                    "token": int(tok),
+                    "index": index,
+                    "text": decode_token(tok),
+                }
+                chaos.fire(
+                    "llm.token",
+                    index=index,
+                    resumed=bool(prior),
+                    tag=payload.get("chaos_tag"),
+                )
+        finally:
+            self._active.pop(request_id, None)
+
+    def cancel(self, request_id: str) -> bool:
+        """Evict ``request_id`` and free its KV blocks now. Idempotent and
+        safe to broadcast: replicas not serving the stream return False."""
+        internal = self._active.get(str(request_id))
+        if internal is None:
+            return False
+        return self.engine.cancel(internal)
+
+    def check_health(self) -> None:
+        """Controller health-check hook: a failed engine (step raised or
+        watchdog fired) reports unhealthy so the replica gets replaced."""
+        if self.engine.failed:
+            raise RuntimeError("llm engine failed; replica must be replaced")
 
     def stats(self) -> dict:
         """Engine introspection (unary method — callable via handle)."""
-        return self.engine.stats()
+        out = self.engine.stats()
+        out["requests_resumed"] = self._resumed_total
+        return out
+
+
+def stream_tokens(handle, payload: dict, *, max_failovers: int = 2):
+    """Stream token chunks from an LLMDeployment handle with automatic
+    mid-stream failover: if the serving replica dies, re-submit to a
+    survivor with ``prior_tokens`` set to everything already received.
+    Deterministic sampling makes the joined stream byte-identical to an
+    uninterrupted run. Returns an iterator of chunk dicts."""
+    payload = dict(payload)
+    payload.setdefault("request_id", uuid.uuid4().hex)
+
+    def resume(chunks):
+        resumed = dict(payload)
+        resumed["prior_tokens"] = [c["token"] for c in chunks]
+        return resumed
+
+    return handle.stream_with_failover(
+        payload, resume=resume, max_failovers=max_failovers
+    )
 
 
 def build_llm_app(

@@ -17,7 +17,26 @@ cache). `DecodeFns.num_compiled_shapes` reports the realized count.
 
 Sampling runs on host (numpy) per request — greedy, temperature, top-k —
 with a per-request RNG so a sequence's output is identical whether it ran
-solo or continuously batched with arbitrary neighbors.
+solo or continuously batched with arbitrary neighbors. The RNG consumes
+exactly one uniform per token, which is what makes mid-stream failover
+byte-identical: a resumed request sets ``start_index`` and the fresh
+engine fast-forwards the RNG past the tokens already delivered.
+
+Failure semantics (docs/SERVING_LLM.md "Failure semantics"):
+
+- ``submit`` applies admission control: a bounded waiting queue
+  (``max_waiting``) and an optional worst-case block budget for queued
+  work (``max_waiting_blocks``), rejecting with ``EngineOverloadedError``
+  rather than queueing unboundedly.
+- per-request deadlines (``SamplingParams.deadline_s``) are enforced at
+  the top of every step; expired sequences are evicted and their streams
+  fail with ``DeadlineExceededError``.
+- ``cancel(request_id)`` evicts a waiting or running sequence and returns
+  its KV blocks (allocation AND leftover reservation) immediately.
+- if a step raises, or wedges past ``step_timeout_s`` (watchdog thread),
+  the engine fails closed: every in-flight stream gets an
+  ``EngineDiedError`` (an ``ActorError`` — clients treat it exactly like
+  replica death and fail over) instead of blocking forever.
 """
 from __future__ import annotations
 
@@ -25,11 +44,18 @@ import queue
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Sequence
 
 import numpy as np
 
+from ray_tpu._private import chaos
+from ray_tpu.exceptions import (
+    DeadlineExceededError,
+    EngineDiedError,
+    EngineOverloadedError,
+    RequestCancelledError,
+)
 from ray_tpu.serve._shapes import pad_to_bucket, pow2_buckets
 from ray_tpu.serve.llm.decode import DecodeFns
 from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
@@ -44,10 +70,14 @@ class SamplingParams:
     temperature: float = 0.0  # <= 0 -> greedy
     top_k: int = 0            # 0 -> full distribution
     seed: int = 0
+    deadline_s: float | None = None  # wall-clock budget from submit()
+    start_index: int = 0      # tokens already delivered (failover resume)
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.start_index < 0:
+            raise ValueError("start_index must be >= 0")
 
 
 @dataclass(frozen=True)
@@ -62,6 +92,9 @@ class EngineConfig:
     length_buckets: tuple[int, ...] | None = None  # None -> pow2 ladder
     eos_id: int | None = None
     seed: int = 0                 # param init seed (when params not given)
+    max_waiting: int = 128        # admission queue bound (overload beyond)
+    max_waiting_blocks: int | None = None  # worst-case block budget queued
+    step_timeout_s: float | None = None    # watchdog: wedged-step ceiling
 
 
 class TokenStream:
@@ -92,7 +125,7 @@ class TokenStream:
 class _Request:
     __slots__ = (
         "id", "prompt", "sampling", "out", "generated", "rng",
-        "reserved_blocks", "done",
+        "reserved_blocks", "done", "deadline",
     )
 
     def __init__(self, req_id, prompt, sampling: SamplingParams):
@@ -102,8 +135,17 @@ class _Request:
         self.out: queue.Queue = queue.Queue()
         self.generated: list[int] = []
         self.rng = np.random.default_rng(sampling.seed)
+        if sampling.start_index:
+            # one uniform per token (see _sample): skipping start_index
+            # draws resumes the stream exactly where the dead replica left it
+            self.rng.random(sampling.start_index)
         self.reserved_blocks = 0
         self.done = False
+        self.deadline = (
+            time.monotonic() + sampling.deadline_s
+            if sampling.deadline_s is not None
+            else None
+        )
 
     @property
     def total_len(self) -> int:
@@ -111,7 +153,15 @@ class _Request:
 
 
 def _sample(logits: np.ndarray, sp: SamplingParams, rng) -> int:
-    """Host-side sampling from one row of f32 logits."""
+    """Host-side sampling from one row of f32 logits.
+
+    Consumes exactly ONE uniform per token (inverse-CDF draw) — greedy
+    consumes none — so a request's RNG position is a pure function of how
+    many tokens it has produced. Mid-stream failover relies on this:
+    re-prefilling ``prompt + generated`` on a fresh engine with
+    ``start_index=len(generated)`` reproduces the remaining tokens
+    byte-identically.
+    """
     if sp.temperature <= 0.0:
         return int(np.argmax(logits))
     l = logits.astype(np.float64) / sp.temperature
@@ -121,7 +171,10 @@ def _sample(logits: np.ndarray, sp: SamplingParams, rng) -> int:
     l = l - l.max()
     p = np.exp(l)
     p /= p.sum()
-    return int(rng.choice(l.shape[-1], p=p))
+    u = rng.random()
+    return int(
+        min(np.searchsorted(np.cumsum(p), u, side="right"), l.shape[-1] - 1)
+    )
 
 
 class LLMEngine:
@@ -193,11 +246,22 @@ class LLMEngine:
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
         self._waiting: deque[_Request] = deque()
+        self._waiting_blocks = 0  # worst-case blocks held by the queue
         self._running: list[_Request] = []
         self._next_id = 0
         self._auto_step = auto_step
         self._thread: threading.Thread | None = None
+        self._watchdog: threading.Thread | None = None
         self._stopped = False
+        # Set by _fail_engine / the watchdog; read WITHOUT the lock (the
+        # whole point is surviving a step that wedged while holding it).
+        self._failed: EngineDiedError | None = None
+        # perf_counter() at step entry, None when no step is in flight —
+        # plain attribute so the watchdog can read it lock-free.
+        self._step_begin: float | None = None
+        self._rejected_total = 0
+        self._cancelled_total = 0
+        self._deadline_total = 0
 
         self._m_tokens = metrics.counter(
             "llm_engine_tokens_generated",
@@ -216,6 +280,18 @@ class LLMEngine:
             boundaries=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0),
             tag_keys=("kind",),
         )
+        self._m_rejected = metrics.counter(
+            "llm_requests_rejected",
+            "Requests rejected by engine admission control (overload)",
+        )
+        self._m_cancelled = metrics.counter(
+            "llm_requests_cancelled",
+            "Requests cancelled (client disconnect / explicit cancel)",
+        )
+        self._m_deadline = metrics.counter(
+            "llm_deadline_exceeded",
+            "Requests evicted because deadline_s expired mid-generation",
+        )
 
     # ---------------- public API ----------------
 
@@ -225,7 +301,12 @@ class LLMEngine:
         sampling: SamplingParams | None = None,
         **sampling_overrides,
     ) -> TokenStream:
-        """Enqueue one request; returns a stream of generated token ids."""
+        """Enqueue one request; returns a stream of generated token ids.
+
+        Raises ``EngineOverloadedError`` when admission control rejects
+        (waiting queue full, or queued worst-case blocks over budget) and
+        ``EngineDiedError`` when the engine has already failed.
+        """
         if sampling is None:
             sampling = SamplingParams(**sampling_overrides)
         elif sampling_overrides:
@@ -242,17 +323,32 @@ class LLMEngine:
                 f"({sampling.max_new_tokens}) exceeds model max_seq_len "
                 f"{self.model_cfg.max_seq_len}"
             )
-        if self.cache.cfg.blocks_for(total) > self.cache.cfg.usable_blocks:
+        need = self.cache.cfg.blocks_for(total)
+        if need > self.cache.cfg.usable_blocks:
             raise ValueError(
-                f"request needs {self.cache.cfg.blocks_for(total)} KV blocks "
+                f"request needs {need} KV blocks "
                 f"but the pool only has {self.cache.cfg.usable_blocks}"
             )
+        if self._failed is not None:
+            raise self._failed
         with self._lock:
             if self._stopped:
                 raise RuntimeError("engine is shut down")
+            if len(self._waiting) >= self.cfg.max_waiting or (
+                self.cfg.max_waiting_blocks is not None
+                and self._waiting_blocks + need > self.cfg.max_waiting_blocks
+            ):
+                self._rejected_total += 1
+                self._m_rejected.inc()
+                raise EngineOverloadedError(
+                    f"admission queue full ({len(self._waiting)} waiting, "
+                    f"{self._waiting_blocks} worst-case blocks queued); "
+                    "retry later"
+                )
             req = _Request(self._next_id, prompt, sampling)
             self._next_id += 1
             self._waiting.append(req)
+            self._waiting_blocks += need
             self._m_queue.set(len(self._waiting))
             self._work.notify_all()
         if self._auto_step:
@@ -274,17 +370,42 @@ class LLMEngine:
         return list(stream)
 
     def step(self) -> bool:
-        """One scheduler iteration: a batched prefill if any request can be
-        admitted, else a batched decode step. Returns False when idle."""
+        """One scheduler iteration: expire deadlines, then a batched
+        prefill if any request can be admitted, else a batched decode
+        step. Returns False when idle."""
         with self._lock:
-            admitted = self._admit_locked()
-            if admitted:
-                self._prefill_locked(admitted)
-                return True
-            if self._running:
-                self._decode_locked()
-                return True
-            return False
+            self._step_begin = time.perf_counter()
+            try:
+                chaos.fire("engine.step")
+                self._expire_deadlines_locked()
+                admitted = self._admit_locked()
+                if admitted:
+                    self._prefill_locked(admitted)
+                    return True
+                if self._running:
+                    self._decode_locked()
+                    return True
+                return False
+            finally:
+                self._step_begin = None
+
+    def cancel(self, request_id) -> bool:
+        """Evict a waiting/running request, fail its stream with
+        ``RequestCancelledError``, and return its KV blocks immediately.
+        Returns False when the request is unknown or already finished
+        (idempotent — safe to broadcast to every replica)."""
+        with self._lock:
+            req = self._find_locked(request_id)
+            if req is None:
+                return False
+            self._evict_locked(req)
+            self._cancelled_total += 1
+            self._m_cancelled.inc()
+            req.out.put(
+                RequestCancelledError(f"request {request_id!r} cancelled")
+            )
+            req.out.put(_DONE)
+            return True
 
     def stats(self) -> dict:
         with self._lock:
@@ -295,27 +416,100 @@ class LLMEngine:
                 "kv_utilization": self.cache.utilization,
                 "kv_high_water_blocks": self.cache.stats.high_water_blocks,
                 "num_compiled_shapes": self.fns.num_compiled_shapes,
+                "rejected_total": self._rejected_total,
+                "cancelled_total": self._cancelled_total,
+                "deadline_exceeded_total": self._deadline_total,
+                "failed": self._failed is not None,
             }
 
     @property
     def num_compiled_shapes(self) -> int:
         return self.fns.num_compiled_shapes
 
+    @property
+    def failed(self) -> bool:
+        return self._failed is not None
+
     def shutdown(self) -> None:
+        """Stop stepping, fail every pending stream with a clear error,
+        and return ALL KV blocks (allocations and reservations) to the
+        pool — repeated create/shutdown in one process is leak-free."""
         with self._lock:
+            if self._stopped:
+                return
             self._stopped = True
+            err = RequestCancelledError("engine shut down")
             for r in list(self._waiting) + self._running:
                 if not r.done:
                     r.done = True
+                    r.out.put(err)
                     r.out.put(_DONE)
+            self.cache.release_all()
             self._waiting.clear()
+            self._waiting_blocks = 0
             self._running.clear()
+            self._m_queue.set(0)
+            self._m_util.set(self.cache.utilization)
             self._work.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        for t in (self._thread, self._watchdog):
+            if t is not None:
+                t.join(timeout=5)
+        self._thread = None
+        self._watchdog = None
 
     # ---------------- scheduler internals (lock held) ----------------
+
+    def _find_locked(self, request_id) -> _Request | None:
+        for r in self._running:
+            if r.id == request_id:
+                return r
+        for r in self._waiting:
+            if r.id == request_id:
+                return r
+        return None
+
+    def _evict_locked(self, r: _Request) -> None:
+        """Remove a live request from the scheduler and return its blocks
+        (allocation + leftover reservation for running; queued worst-case
+        budget for waiting). Does NOT touch the output stream."""
+        if r in self._running:
+            self._running.remove(r)
+            leftover = r.reserved_blocks - self.cache.num_allocated(r.id)
+            self.cache.free(r.id)
+            if leftover > 0:
+                self.cache.release_reservation(leftover)
+        else:
+            try:
+                self._waiting.remove(r)
+            except ValueError:  # pragma: no cover — already gone
+                pass
+            else:
+                self._waiting_blocks -= self.cache.cfg.blocks_for(
+                    len(r.prompt) + r.sampling.max_new_tokens
+                )
+        r.done = True
+        self._m_queue.set(len(self._waiting))
+        self._m_util.set(self.cache.utilization)
+        self._work.notify_all()  # freed blocks may unblock admissions
+
+    def _expire_deadlines_locked(self) -> None:
+        now = time.monotonic()
+        for r in [
+            r
+            for r in list(self._waiting) + self._running
+            if r.deadline is not None and now >= r.deadline
+        ]:
+            self._evict_locked(r)
+            self._deadline_total += 1
+            self._m_deadline.inc()
+            r.out.put(
+                DeadlineExceededError(
+                    f"request {r.id!r} deadline "
+                    f"({r.sampling.deadline_s}s) expired after "
+                    f"{len(r.generated)} tokens"
+                )
+            )
+            r.out.put(_DONE)
 
     def _admit_locked(self) -> list[_Request]:
         admitted: list[_Request] = []
@@ -333,6 +527,7 @@ class LLMEngine:
             self.cache.reserve(need)
             req.reserved_blocks = need
             admitted.append(self._waiting.popleft())
+            self._waiting_blocks -= need
         if admitted:
             self._m_queue.set(len(self._waiting))
         return admitted
@@ -340,6 +535,7 @@ class LLMEngine:
     def _prefill_locked(self, admitted: list[_Request]) -> None:
         import jax.numpy as jnp
 
+        chaos.fire("engine.prefill", batch=len(admitted))
         t0 = time.perf_counter()
         bs = self.cfg.block_size
         for r in admitted:
@@ -374,6 +570,7 @@ class LLMEngine:
     def _decode_locked(self) -> None:
         import jax.numpy as jnp
 
+        chaos.fire("engine.decode", batch=len(self._running))
         t0 = time.perf_counter()
         bs = self.cfg.block_size
         batch = list(self._running)
@@ -424,34 +621,63 @@ class LLMEngine:
         r.out.put(_DONE)
         self._work.notify_all()  # freed blocks may unblock admissions
 
+    # ---------------- failure handling ----------------
+
+    def _fail_engine(self, e: BaseException) -> None:
+        """A step raised: fail closed. Every in-flight stream gets an
+        EngineDiedError (= ActorError, so handles fail over exactly as on
+        replica death) and the cache is reset best-effort."""
+        if isinstance(e, EngineDiedError):
+            err = e
+        else:
+            err = EngineDiedError(f"engine step failed: {e!r}")
+            err.__cause__ = e
+        with self._lock:
+            self._failed = err
+            self._fan_out_failure(err)
+
+    def _fan_out_failure(self, err: EngineDiedError) -> None:
+        for r in list(self._waiting) + list(self._running):
+            if not r.done:
+                r.done = True
+                r.out.put(err)
+                r.out.put(_DONE)
+        self._waiting.clear()
+        self._waiting_blocks = 0
+        self._running = []
+        self.cache.release_all()
+
     # ---------------- background stepping ----------------
 
     def _ensure_thread(self) -> None:
         with self._lock:
-            if self._thread is not None or self._stopped:
+            if self._stopped or self._failed is not None:
                 return
-            self._thread = threading.Thread(
-                target=self._loop, name="llm-engine-step", daemon=True
-            )
-            self._thread.start()
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="llm-engine-step", daemon=True
+                )
+                self._thread.start()
+            if self._watchdog is None and self.cfg.step_timeout_s:
+                self._watchdog = threading.Thread(
+                    target=self._watchdog_loop,
+                    name="llm-engine-watchdog",
+                    daemon=True,
+                )
+                self._watchdog.start()
 
     def _loop(self) -> None:
         while True:
             with self._lock:
                 if self._stopped:
                     return
+            if self._failed is not None:
+                return
             try:
                 progressed = self.step()
-            except Exception as e:  # noqa: BLE001 — fan out to all streams
-                with self._lock:
-                    for r in list(self._waiting) + self._running:
-                        if not r.done:
-                            r.done = True
-                            r.out.put(e)
-                            r.out.put(_DONE)
-                    self._waiting.clear()
-                    self._running.clear()
-                continue
+            except Exception as e:  # noqa: BLE001 — fail closed, fan out
+                self._fail_engine(e)
+                return
             if not progressed:
                 with self._work:
                     if (
@@ -460,3 +686,28 @@ class LLMEngine:
                         and not self._running
                     ):
                         self._work.wait(timeout=0.05)
+
+    def _watchdog_loop(self) -> None:
+        """Detect a wedged step. Deliberately LOCK-FREE: the failure mode
+        is a jitted call stuck while holding the scheduler lock, so the
+        watchdog reads ``_step_begin`` as a plain attribute and fans the
+        failure out through the (thread-safe) per-request queues. The
+        wedged thread still holds the lock; clients stop waiting anyway
+        and the controller replaces the replica via check_health()."""
+        timeout = self.cfg.step_timeout_s
+        poll = max(0.005, min(0.05, timeout / 10.0))
+        while not self._stopped and self._failed is None:
+            begin = self._step_begin
+            if begin is not None and time.perf_counter() - begin > timeout:
+                err = EngineDiedError(
+                    f"engine step wedged for > {timeout}s; "
+                    "failing all in-flight streams"
+                )
+                self._failed = err
+                for r in list(self._waiting) + list(self._running):
+                    if not r.done:
+                        r.done = True
+                        r.out.put(err)
+                        r.out.put(_DONE)
+                return
+            time.sleep(poll)

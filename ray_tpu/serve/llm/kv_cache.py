@@ -126,6 +126,16 @@ class PagedKVCache:
         self.stats.freed_total += len(table)
         return len(table)
 
+    def release_all(self) -> int:
+        """Free every sequence and drop all reservations (engine failure /
+        shutdown path); -> blocks returned. Afterwards the free list is
+        full again, so repeated engine create/shutdown cannot leak."""
+        returned = 0
+        for seq_id in list(self._tables):
+            returned += self.free(seq_id)
+        self._reserved = 0
+        return returned
+
     # ---------------- views ----------------
 
     @property

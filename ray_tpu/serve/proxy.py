@@ -9,13 +9,55 @@ handler results are returned as JSON.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import threading
+import uuid
 from typing import Any
 
 import ray_tpu
+from ray_tpu.exceptions import (
+    DeadlineExceededError,
+    EngineOverloadedError,
+    RequestCancelledError,
+    TaskError,
+)
 from ray_tpu.serve.config import HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponseGenerator
+
+
+def _unwrap(e: BaseException) -> BaseException:
+    if isinstance(e, TaskError) and e.cause is not None:
+        return e.cause
+    return e
+
+
+def _status_for(e: BaseException) -> tuple[int, dict]:
+    """Map framework errors to HTTP degradation statuses: overload is
+    retryable (503 + Retry-After), a blown deadline is a gateway timeout
+    (504), a cancelled request is nginx's client-closed-request (499)."""
+    e = _unwrap(e)
+    if isinstance(e, EngineOverloadedError):
+        return 503, {"Retry-After": "1"}
+    if isinstance(e, DeadlineExceededError):
+        return 504, {}
+    if isinstance(e, RequestCancelledError):
+        return 499, {}
+    return 500, {}
+
+
+class _PrefetchedStream:
+    """A streaming response whose FIRST chunk was already fetched on the
+    executor thread. Fetching one chunk before building the HTTP response
+    means admission-control/deadline errors surface while the status line
+    is still unsent — so overload really is a 503, not a 200 + mid-stream
+    error chunk."""
+
+    def __init__(self, chunks):
+        self.chunks = chunks
+
+    def __iter__(self):
+        return iter(self.chunks)
 
 
 class HTTPProxy:
@@ -95,7 +137,8 @@ class HTTPProxy:
                 return chunk.encode()
             return json.dumps(chunk).encode() + b"\n"
 
-        async def stream_response(request, response_gen) -> "web.StreamResponse":
+        async def stream_response(request, response_gen,
+                                  on_disconnect=None) -> "web.StreamResponse":
             """Pump chunks from the blocking DeploymentResponseGenerator
             (iterated on an executor thread) out the socket as they arrive
             — token streaming for LLM decode (reference:
@@ -130,16 +173,25 @@ class HTTPProxy:
 
             threading.Thread(target=pump, daemon=True,
                              name="serve-stream-pump").start()
-            while True:
-                item = await queue.get()
-                if item is _END:
-                    break
-                if isinstance(item, BaseException):
-                    await resp.write(_encode_chunk(
-                        {"error": str(item)}, sse))
-                    break
-                await resp.write(_encode_chunk(item, sse))
-            await resp.write_eof()
+            try:
+                while True:
+                    item = await queue.get()
+                    if item is _END:
+                        break
+                    if isinstance(item, BaseException):
+                        await resp.write(_encode_chunk(
+                            {"error": str(item)}, sse))
+                        break
+                    await resp.write(_encode_chunk(item, sse))
+                await resp.write_eof()
+            except (ConnectionResetError, ConnectionError,
+                    asyncio.CancelledError):
+                # client went away mid-stream: free the replica-side
+                # sequence (and its KV blocks) instead of decoding into
+                # the void until max_new_tokens
+                if on_disconnect is not None:
+                    on_disconnect()
+                raise
             return resp
 
         async def handler(request: web.Request) -> web.Response:
@@ -160,14 +212,35 @@ class HTTPProxy:
             # The whole call (routing included) runs in the executor: the
             # router does blocking controller RPCs and may sleep waiting for
             # replicas, which must never stall the event loop. For generator
-            # ingresses the handle returns a response GENERATOR immediately
-            # (dispatch is non-blocking); chunks are pumped by stream_response.
+            # ingresses the first chunk is ALSO fetched there, so admission
+            # and deadline errors map to a status code before the response
+            # headers go out; remaining chunks are pumped by stream_response.
+            state: dict[str, Any] = {}
+
             def call_blocking():
+                nonlocal payload
                 handle = DeploymentHandle(ingress, app_name).options(
                     stream_chunk_timeout_s=self.options.request_timeout_s)
+                if isinstance(payload, dict):
+                    try:
+                        streaming_ingress = "__call__" in handle.stream_methods()
+                    except Exception:  # noqa: BLE001 — best-effort tag
+                        streaming_ingress = False
+                    if streaming_ingress:
+                        # tag the request so a client disconnect can cancel
+                        # it on whichever replica is serving the stream
+                        payload = dict(payload)
+                        payload.setdefault("request_id", uuid.uuid4().hex)
+                        state["request_id"] = payload["request_id"]
+                        state["handle"] = handle
                 response = handle.remote(payload)
                 if isinstance(response, DeploymentResponseGenerator):
-                    return response
+                    it = iter(response)
+                    try:
+                        first = next(it)
+                    except StopIteration:
+                        return _PrefetchedStream(())
+                    return _PrefetchedStream(itertools.chain([first], it))
                 return response.result(
                     timeout=self.options.request_timeout_s)
 
@@ -176,9 +249,21 @@ class HTTPProxy:
                     None, call_blocking
                 )
             except Exception as e:  # noqa: BLE001 — surface to the client
-                return web.json_response({"error": str(e)}, status=500)
-            if isinstance(result, DeploymentResponseGenerator):
-                return await stream_response(request, result)
+                status, headers = _status_for(e)
+                return web.json_response(
+                    {"error": str(e)}, status=status, headers=headers)
+            if isinstance(result, _PrefetchedStream):
+                def on_disconnect():
+                    rid = state.get("request_id")
+                    handle = state.get("handle")
+                    if rid is None or handle is None:
+                        return
+                    threading.Thread(
+                        target=lambda: handle.broadcast("cancel", rid),
+                        daemon=True, name="serve-cancel",
+                    ).start()
+
+                return await stream_response(request, result, on_disconnect)
             if isinstance(result, (dict, list, str, int, float, bool, type(None))):
                 return web.json_response({"result": result})
             return web.json_response({"result": repr(result)})

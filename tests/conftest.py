@@ -50,6 +50,73 @@ def jax_cpu():
     return jax
 
 
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Per-test wall-clock ceiling: ``@pytest.mark.timeout(seconds)``.
+
+    The fault-tolerance tests intentionally wedge engines; a bug in the
+    watchdog/failover path must fail THAT test fast, not eat the tier-1
+    budget. Implemented here (pytest-timeout is not in the image): the
+    test body runs on a daemon thread and an expiry fails the test. The
+    abandoned thread keeps running — acceptable for a test process,
+    matching pytest-timeout's "thread" method semantics.
+    """
+    import threading
+
+    marker = pyfuncitem.get_closest_marker("timeout")
+    if marker is None:
+        return None
+    seconds = float(marker.args[0]) if marker.args else 60.0
+    args = {
+        name: pyfuncitem.funcargs[name]
+        for name in pyfuncitem._fixtureinfo.argnames
+    }
+    result: dict = {}
+
+    def run():
+        try:
+            pyfuncitem.obj(**args)
+        except BaseException as e:  # noqa: BLE001 — re-raised on main thread
+            result["error"] = e
+
+    t = threading.Thread(target=run, daemon=True, name=f"timeout-{pyfuncitem.name}")
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        pytest.fail(
+            f"test exceeded timeout marker ({seconds}s)", pytrace=False
+        )
+    if "error" in result:
+        raise result["error"]
+    return True
+
+
+@pytest.fixture
+def chaos_plan():
+    """Install a deterministic fault plan for this test.
+
+    Usage: ``chaos_plan(FaultPlan(faults=(Fault(...),)))`` — activates
+    in-process (for direct engine tests) AND exports RAY_TPU_CHAOS_PLAN so
+    worker processes spawned AFTER the call inherit it (cluster tests must
+    therefore install the plan before ``ray_tpu.init``/``serve.run``).
+    Cleared on teardown either way.
+    """
+    from ray_tpu._private import chaos
+
+    prev = os.environ.get(chaos.ENV_VAR)
+
+    def _install(plan):
+        os.environ[chaos.ENV_VAR] = plan.to_json()
+        return chaos.install(plan)
+
+    yield _install
+    chaos.clear()
+    if prev is None:
+        os.environ.pop(chaos.ENV_VAR, None)
+    else:
+        os.environ[chaos.ENV_VAR] = prev
+
+
 @pytest.fixture
 def ray_start(request):
     """Fresh single-node cluster per test; params override init kwargs."""

@@ -101,6 +101,27 @@ def test_store_daemon_clean_under_asan(tmp_path):
     assert "AddressSanitizer" not in err, f"heap error(s):\n{err[:4000]}"
 
 
+def test_no_bare_except_in_serving_path():
+    """Failure-semantics lint (ISSUE 2): the LLM serving path and the
+    chaos harness must never swallow exceptions with a bare ``except:`` —
+    fault propagation (EngineDiedError fan-out, failover retry
+    classification) depends on errors reaching their handlers typed."""
+    import ast
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    targets = sorted((root / "ray_tpu" / "serve" / "llm").rglob("*.py"))
+    targets.append(root / "ray_tpu" / "_private" / "chaos.py")
+    assert targets, "serving path sources not found"
+    offenders = []
+    for path in targets:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                offenders.append(f"{path.relative_to(root)}:{node.lineno}")
+    assert not offenders, f"bare except clauses: {offenders}"
+
+
 SCHED_DRIVER = r"""
 #include <cstdint>
 #include <cstdio>
