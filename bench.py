@@ -444,6 +444,11 @@ def main() -> None:
         aux_bench(_resnet, "resnet", 75.0)
 
     aux_collect(overhead_proc, "trainer_overhead_pct")
+    # serving-path metrics (prefix-cache hit rate, prefill tokens/sec):
+    # cheap CPU subprocess, collected before the contention-sensitive PPO
+    # bench below starts so the two never overlap
+    llm_proc = aux_spawn("ray_tpu.benchmarks.llm_serving", 60.0)
+    aux_collect(llm_proc, "llm_serving")
     # second north-star metric (BASELINE.json): contention-SENSITIVE, so
     # it runs alone after everything else, with whatever budget remains
     ppo_proc = aux_spawn("ray_tpu.benchmarks.rllib_throughput", 75.0)

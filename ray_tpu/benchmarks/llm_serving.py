@@ -1,0 +1,129 @@
+"""LLM serving throughput: prefix-cache hit rate + prefill tokens/sec.
+
+Models the dominant production shape (ROADMAP north-star: heavy serving
+traffic): a fleet of requests sharing a long system prompt, with short
+per-request tails. Two phases over one engine:
+
+- COLD: the first wave pays full prefill and populates the block-granular
+  prefix cache (serve/llm/kv_cache.py).
+- WARM: subsequent waves map the shared prefix onto resident KV blocks,
+  so only the tail is computed.
+
+Reported (one JSON line, merged into bench.py's aux results under
+``llm_serving``):
+
+- ``llm_prefix_hit_rate``     hit_tokens / (hit + computed) over the
+                              whole run (warm waves dominate)
+- ``llm_prefill_tokens_per_sec``  prompt tokens RETIRED per second of
+                              prefill-phase wall clock during the warm
+                              waves — cache hits retire tokens without
+                              computing them, so this is the number the
+                              prefix cache actually moves
+- ``llm_decode_tokens_per_sec``   generated tokens / decode wall time
+
+Runs on CPU with the tiny llama config — the point is tracking the
+scheduler/cache overheads and the hit-rate plumbing release-over-release,
+not absolute TPU throughput (bench.py GPT-MFU owns that axis).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+SHARED_PREFIX_TOKENS = 96
+TAIL_TOKENS = 4
+WAVES = 4           # first wave is cold, the rest hit the prefix cache
+WAVE_REQUESTS = 8
+MAX_NEW_TOKENS = 8
+
+
+def run_serving_bench() -> dict:
+    import numpy as np
+
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    from ray_tpu.models.llama import LlamaConfig
+
+    mc = LlamaConfig.tiny()
+    eng = LLMEngine(
+        EngineConfig(
+            model="llama",
+            model_config=mc,
+            block_size=8,
+            num_blocks=256,
+            max_batch_size=WAVE_REQUESTS,
+            max_prefill_batch=WAVE_REQUESTS,
+        ),
+        auto_step=False,
+    )
+    rng = np.random.default_rng(0)
+    prefix = [int(t) for t in rng.integers(1, mc.vocab_size, SHARED_PREFIX_TOKENS)]
+
+    def wave(wave_idx: int) -> tuple[float, float]:
+        """Run one wave of shared-prefix requests; returns wall seconds
+        spent in (prefill steps, decode steps)."""
+        streams = [
+            eng.submit(
+                prefix
+                + [
+                    int(t)
+                    for t in rng.integers(1, mc.vocab_size, TAIL_TOKENS)
+                ],
+                max_new_tokens=MAX_NEW_TOKENS,
+            )
+            for _ in range(WAVE_REQUESTS)
+        ]
+        prefill_s = decode_s = 0.0
+        for _ in range(10_000):
+            if all(s.done for s in streams):
+                break
+            t0 = time.perf_counter()
+            if not eng.step():
+                break
+            dt = time.perf_counter() - t0
+            if eng.last_step_kind == "prefill":
+                prefill_s += dt
+            else:
+                decode_s += dt
+        for s in streams:
+            list(s)
+        return prefill_s, decode_s
+
+    wave(0)  # cold: compile + populate the prefix cache
+    warm_prompt_tokens = 0
+    warm_prefill_s = warm_decode_s = 0.0
+    for i in range(1, WAVES):
+        before = eng.stats()
+        p, d = wave(i)
+        warm_prefill_s += p
+        warm_decode_s += d
+        after = eng.stats()
+        warm_prompt_tokens += (
+            after["prefix_hit_tokens"] - before["prefix_hit_tokens"]
+        ) + (
+            after["prefill_tokens_total"] - before["prefill_tokens_total"]
+        )
+    st = eng.stats()
+    generated = (WAVES - 1) * WAVE_REQUESTS * MAX_NEW_TOKENS
+    eng.shutdown()
+    return {
+        "llm_prefix_hit_rate": round(st["prefix_hit_rate"], 4),
+        "llm_prefill_tokens_per_sec": round(
+            warm_prompt_tokens / max(warm_prefill_s, 1e-9), 1
+        ),
+        "llm_decode_tokens_per_sec": round(
+            generated / max(warm_decode_s, 1e-9), 1
+        ),
+        "prefix_hit_tokens": st["prefix_hit_tokens"],
+        "prefill_tokens_computed": st["prefill_tokens_total"],
+        "cow_blocks": st["cow_blocks"],
+        "prefix_evicted_blocks": st["prefix_evicted_blocks"],
+    }
+
+
+def main() -> None:
+    print(json.dumps({"llm_serving": run_serving_bench()}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -316,25 +316,43 @@ def gpt_prefill(
     lengths: jax.Array,
     block_tables: jax.Array,
     cfg: GPTConfig,
+    start: jax.Array | None = None,
 ):
-    """Prompt pass: run the full causal forward over right-padded prompts,
+    """Prompt pass: run the causal forward over right-padded prompts,
     writing every valid position's K/V into the paged cache.
 
     tokens [B, S] int32, lengths [B] (valid prefix per row; padding rows
-    use length 1 + an all-garbage block table), block_tables [B, S//Bs].
+    use length 1 + an all-garbage block table), block_tables [B, NB].
     Returns (last-valid-token logits [B, V] f32, cache_k', cache_v').
-    Attention uses the XLA reference kernel — prefill happens once per
-    request at bucketed shapes, where flash's grid setup buys nothing.
+
+    ``start=None``: the whole prompt starts at position 0 and attention is
+    the XLA reference kernel over the chunk alone — prefill happens once
+    per request at bucketed shapes, where flash's grid setup buys nothing.
+    ``start`` [B] int32 (chunked prefill / prefix-cache hits): row b's
+    tokens sit at TRUE positions start[b].. and earlier positions are
+    already resident in the paged cache, so positional embeddings index
+    the true positions and attention gathers the full paged context
+    (``paged_prefill_attention``).
     """
-    from ray_tpu.ops.kv_cache import write_kv
+    from ray_tpu.ops.kv_cache import paged_prefill_attention, write_kv
 
     B, S = tokens.shape
     D = cfg.d_model
-    x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(
-        cfg.dtype
-    )[:S]
-    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-    valid = pos < lengths[:, None]
+    if start is None:
+        pos = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+        )
+        x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(
+            cfg.dtype
+        )[:S]
+    else:
+        pos = start[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+        # padding columns can run past the table; they are masked anyway
+        emb_pos = jnp.minimum(pos, cfg.max_seq_len - 1)
+        x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(
+            cfg.dtype
+        )[emb_pos]
+    valid = jnp.arange(S, dtype=jnp.int32)[None, :] < lengths[:, None]
 
     def body(x, xs):
         bp, k_layer, v_layer = xs
@@ -342,13 +360,19 @@ def gpt_prefill(
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
         )
-        attn = mha_reference(
-            q.transpose(0, 2, 1, 3),
-            kk.transpose(0, 2, 1, 3),
-            vv.transpose(0, 2, 1, 3),
-            causal=True,
-        )
-        x = _attn_residual(x, attn.transpose(0, 2, 1, 3).reshape(B, S, D), bp, cfg)
+        if start is None:
+            attn = mha_reference(
+                q.transpose(0, 2, 1, 3),
+                kk.transpose(0, 2, 1, 3),
+                vv.transpose(0, 2, 1, 3),
+                causal=True,
+            ).transpose(0, 2, 1, 3).reshape(B, S, D)
+        else:
+            attn = paged_prefill_attention(
+                q, k_layer, v_layer, block_tables,
+                jnp.where(valid, pos, 0),
+            ).reshape(B, S, D)
+        x = _attn_residual(x, attn, bp, cfg)
         x = _mlp_residual(x, bp, cfg)
         return x, (k_layer, v_layer)
 

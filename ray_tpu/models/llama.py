@@ -363,33 +363,59 @@ def llama_prefill(
     lengths: jax.Array,
     block_tables: jax.Array,
     cfg: LlamaConfig,
+    start: jax.Array | None = None,
 ):
-    """Prompt pass with paged-cache writes; see gpt_prefill. RoPE runs at
-    positions 0..S-1 exactly as the full forward. Returns
-    (last-valid-token logits [B, V] f32, cache_k', cache_v')."""
-    from ray_tpu.ops.kv_cache import write_kv
+    """Prompt pass with paged-cache writes; see gpt_prefill. Returns
+    (last-valid-token logits [B, V] f32, cache_k', cache_v').
+
+    ``start=None`` (the whole-prompt path): RoPE runs at positions 0..S-1
+    and attention is the causal reference kernel over the chunk alone.
+
+    ``start`` [B] int32 (the chunked-prefill / prefix-cache path): row b's
+    tokens sit at TRUE positions start[b]..start[b]+lengths[b]-1; earlier
+    positions are already resident in the paged cache (a previous chunk,
+    or blocks mapped from the prefix cache), so attention gathers the full
+    paged context (``paged_prefill_attention``) instead of looking only at
+    the chunk. RoPE indexes the true positions, exactly like decode.
+    """
+    from ray_tpu.ops.kv_cache import paged_prefill_attention, write_kv
 
     B, S = tokens.shape
     D = cfg.d_model
     x = params["wte"].astype(cfg.dtype)[tokens]
-    cos, sin = rope_cache(S, cfg.head_dim, cfg.rope_theta)
-    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-    valid = pos < lengths[:, None]
+    if start is None:
+        cos, sin = rope_cache(S, cfg.head_dim, cfg.rope_theta)
+        pos = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+        )
+        rope_pos = None  # cos/sin already sliced to 0..S-1
+    else:
+        cos, sin = rope_cache(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        pos = start[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+        # padding columns can run past the table; they are masked anyway
+        rope_pos = jnp.minimum(pos, cfg.max_seq_len - 1)
+    valid = jnp.arange(S, dtype=jnp.int32)[None, :] < lengths[:, None]
 
     def body(x, xs):
         bp, k_layer, v_layer = xs
-        q, kk, vv = _attn_qkv(x, bp, cos, sin, cfg)
+        q, kk, vv = _attn_qkv(x, bp, cos, sin, cfg, positions=rope_pos)
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
         )
-        # mha_reference repeats GQA kv heads internally
-        attn = mha_reference(
-            q.transpose(0, 2, 1, 3),
-            kk.transpose(0, 2, 1, 3),
-            vv.transpose(0, 2, 1, 3),
-            causal=True,
-        )
-        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
+        if start is None:
+            # mha_reference repeats GQA kv heads internally
+            attn = mha_reference(
+                q.transpose(0, 2, 1, 3),
+                kk.transpose(0, 2, 1, 3),
+                vv.transpose(0, 2, 1, 3),
+                causal=True,
+            )
+            attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
+        else:
+            attn = paged_prefill_attention(
+                q, k_layer, v_layer, block_tables,
+                jnp.where(valid, pos, 0),
+            ).reshape(B, S, D)
         x = x + attn @ bp["wo"].astype(cfg.dtype)
         x, _ = _ffn_residual(x, bp, cfg)
         return x, (k_layer, v_layer)

@@ -1,4 +1,5 @@
-"""Paged KV-cache array primitives: block-granular write / gather / attend.
+"""Paged KV-cache array primitives: block-granular write / gather / attend /
+copy (COW for the prefix cache).
 
 The serving-side counterpart of ops/attention.py. A paged cache stores one
 layer's keys/values as fixed-size physical blocks
@@ -87,6 +88,70 @@ def gather_kv(
     keys = k_layer[block_tables].reshape(B, NB * Bs, H, hd)
     values = v_layer[block_tables].reshape(B, NB * Bs, H, hd)
     return keys, values
+
+
+def paged_prefill_attention(
+    q: jax.Array,
+    k_layer: jax.Array,
+    v_layer: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: float | None = None,
+) -> jax.Array:
+    """Multi-token (chunked-prefill) attention over a paged cache.
+
+    q: [B, S, H_q, hd] — a CHUNK of queries whose K/V were already written
+    via ``write_kv`` (so each query's own position is in the cache), with
+    ``positions`` [B, S] giving every query's TRUE logical position. Each
+    query attends over the sequence's full gathered context with the mask
+    ``t <= position`` — i.e. all previously-cached tokens (an earlier
+    chunk, or blocks mapped from a prefix cache) plus the causal part of
+    its own chunk. Padding queries attend at whatever clamped position the
+    caller gave them; their outputs are garbage the caller discards.
+    Returns [B, S, H_q, hd] in q.dtype; GQA as in ``paged_attention``.
+    """
+    B, S, Hq, hd = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    keys, values = gather_kv(k_layer, v_layer, block_tables)  # [B,T,Hkv,hd]
+    Hkv = keys.shape[2]
+    if Hq != Hkv:
+        rep = Hq // Hkv
+        keys = jnp.repeat(keys, rep, axis=2)
+        values = jnp.repeat(values, rep, axis=2)
+    logits = jnp.einsum(
+        "bshd,bthd->bsht", q, keys, preferred_element_type=jnp.float32
+    ) * scale
+    T = keys.shape[1]
+    mask = (
+        jnp.arange(T, dtype=positions.dtype)[None, None, :]
+        <= positions[:, :, None]
+    )  # [B, S, T]
+    logits = jnp.where(mask[:, :, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(values.dtype)
+    return jnp.einsum("bsht,bthd->bshd", probs, values).astype(q.dtype)
+
+
+def _copy_blocks(
+    cache_k: jax.Array, cache_v: jax.Array, src: jax.Array, dst: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    # cache_k/v: [n_layer, num_blocks, block_size, H_kv, hd]; src/dst: [P].
+    return (
+        cache_k.at[:, dst].set(cache_k[:, src]),
+        cache_v.at[:, dst].set(cache_v[:, src]),
+    )
+
+
+# Copy-on-write block duplication for the prefix cache: when a sequence
+# must append into a block it shares with other sequences (or that is
+# registered in the prefix-cache hash map), the host allocator points the
+# sequence at a fresh block and this op clones the shared content into it,
+# across all layers in one fused gather+scatter. Callers pad the (src,
+# dst) id lists to a small bucket with (0, 0) identity pairs — copying
+# the garbage block onto itself is a no-op — so the jitted shape set
+# stays closed. Jitted once at module level: every engine in the process
+# shares the compiled programs (same discipline as decode.py's _jit_cache).
+copy_blocks = jax.jit(_copy_blocks)
 
 
 def paged_attention(

@@ -13,6 +13,13 @@ Prompts are token-id lists, or strings encoded with the built-in
 byte-level tokenizer (token = UTF-8 byte value; any vocab >= 256 works) —
 a real BPE vocabulary plugs in by passing token ids directly.
 
+Repeat traffic with shared prompt prefixes (system prompts, few-shot
+headers) is served from the engine's block-granular KV prefix cache —
+``stats()`` exposes ``prefix_hit_tokens`` / ``prefix_hit_rate`` /
+``prefix_cached_blocks`` / ``prefix_evicted_blocks`` / ``cow_blocks`` per
+replica alongside the PR 1/2 fields (docs/SERVING_LLM.md "Prefix caching
+& chunked prefill").
+
 Failure semantics (docs/SERVING_LLM.md): every chunk carries
 ``(request_id, index)`` where ``index`` is the ABSOLUTE token position,
 so a client (``stream_tokens`` / ``DeploymentHandle.stream_with_failover``)

@@ -74,12 +74,26 @@ class DecodeFns:
         self.init, self._prefill, self._decode = _jitted(family, model_cfg)
         self._signatures: set[tuple] = set()
 
-    def prefill(self, params, cache_k, cache_v, tokens, lengths, block_tables):
+    def prefill(
+        self, params, cache_k, cache_v, tokens, lengths, block_tables,
+        start=None,
+    ):
+        # start=None is the monolithic whole-prompt path (positions are
+        # arange over the chunk, reference-attention formulation); a [B]
+        # start array is the chunked/prefix path (true positions, paged
+        # attention over already-resident context). The two trace to
+        # different programs, so they get distinct signature kinds.
+        kind = "prefill" if start is None else "prefill_chunk"
         self._signatures.add(
-            ("prefill", tuple(tokens.shape), tuple(block_tables.shape))
+            (kind, tuple(tokens.shape), tuple(block_tables.shape))
         )
+        if start is None:
+            return self._prefill(
+                params, cache_k, cache_v, tokens, lengths, block_tables
+            )
         return self._prefill(
-            params, cache_k, cache_v, tokens, lengths, block_tables
+            params, cache_k, cache_v, tokens, lengths, block_tables,
+            start=start,
         )
 
     def decode(self, params, cache_k, cache_v, tokens, positions, block_tables):

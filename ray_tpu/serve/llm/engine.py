@@ -1,24 +1,43 @@
-"""Continuous-batching scheduler: admission, prefill/decode interleave,
-per-step join/evict, bucketed shapes.
+"""Continuous-batching scheduler: admission, chunked-prefill/decode
+interleave, prefix-cache reuse, per-step join/evict, bucketed shapes.
 
 The loop is the Orca/vLLM iteration-level scheduler: every step is EITHER
-one batched prefill (admitting waiting requests) or one batched decode
-step over all running sequences — new requests join the decode batch at
-the next step after their prefill, finished sequences leave it the step
-they complete, and their KV blocks return to the pool immediately.
+one batched prefill CHUNK (new admissions, or the next slice of a long
+prompt) or one batched decode step over all running sequences — new
+requests join the decode batch at the step after their prefill completes,
+finished sequences leave it the step they complete, and their KV blocks
+return to the pool immediately.
+
+Two serving-throughput optimizations sit on top of PR 1/2's engine:
+
+- **Prefix caching** (SGLang's RadixAttention idea at block granularity):
+  admission maps the longest content-addressed full-block prefix of a new
+  prompt onto blocks already resident in the paged cache, so shared system
+  prompts / few-shot headers cost ZERO prefill compute on repeat traffic.
+  Shared blocks are refcounted and copy-on-write; unreferenced cached
+  blocks are evicted LRU when the free list runs dry (kv_cache.py).
+- **Chunked prefill**: a prompt's uncached suffix is prefilled in
+  ``prefill_chunk_tokens``-sized bucketed slices, and the scheduler
+  ALTERNATES prefill chunks with decode steps, so a long new prompt never
+  head-of-line-blocks tokens streaming from running sequences.
 
 TPU-first constraint: every jitted call's shape is drawn from a closed
 set. Batch sizes pad to ``batch_buckets`` and token/context lengths to
 ``length_buckets`` (serve/_shapes.py pad_to_bucket — the same rule the
-@serve.batch router uses), so total compiled programs are bounded by
-2 * |batch_buckets| * |length_buckets| no matter the traffic mix
-(arxiv 2011.03641: static-shape batching to stay inside the compile
-cache). `DecodeFns.num_compiled_shapes` reports the realized count.
+@serve.batch router uses), so compiled programs stay bounded no matter
+the traffic mix (arxiv 2011.03641: static-shape batching to stay inside
+the compile cache). Chunk prefills reuse the SAME length buckets for both
+the chunk width and the context extent, so they add at most one more
+bounded signature family ("prefill_chunk") next to the monolithic
+"prefill" and "decode" kinds. `DecodeFns.num_compiled_shapes` reports the
+realized count.
 
 Sampling runs on host (numpy) per request — greedy, temperature, top-k —
 with a per-request RNG so a sequence's output is identical whether it ran
 solo or continuously batched with arbitrary neighbors. The RNG consumes
-exactly one uniform per token, which is what makes mid-stream failover
+exactly one uniform per token ON EVERY PATH (greedy included — its argmax
+ignores the draw, but burning it keeps the RNG position a pure function
+of tokens produced), which is what makes mid-stream failover
 byte-identical: a resumed request sets ``start_index`` and the fresh
 engine fast-forwards the RNG past the tokens already delivered.
 
@@ -27,12 +46,16 @@ Failure semantics (docs/SERVING_LLM.md "Failure semantics"):
 - ``submit`` applies admission control: a bounded waiting queue
   (``max_waiting``) and an optional worst-case block budget for queued
   work (``max_waiting_blocks``), rejecting with ``EngineOverloadedError``
-  rather than queueing unboundedly.
+  rather than queueing unboundedly. When the HEAD of the queue doesn't
+  fit, admission probes up to ``admission_probe`` smaller requests behind
+  it (bounded skip-ahead), with an aging cap (``admission_max_skips``) so
+  a large prompt cannot be starved forever.
 - per-request deadlines (``SamplingParams.deadline_s``) are enforced at
   the top of every step; expired sequences are evicted and their streams
   fail with ``DeadlineExceededError``.
-- ``cancel(request_id)`` evicts a waiting or running sequence and returns
-  its KV blocks (allocation AND leftover reservation) immediately.
+- ``cancel(request_id)`` evicts a waiting, prefilling, or running
+  sequence and returns its KV blocks (allocation AND leftover
+  reservation) immediately.
 - if a step raises, or wedges past ``step_timeout_s`` (watchdog thread),
   the engine fails closed: every in-flight stream gets an
   ``EngineDiedError`` (an ``ActorError`` — clients treat it exactly like
@@ -87,7 +110,7 @@ class EngineConfig:
     block_size: int = 16
     num_blocks: int = 64
     max_batch_size: int = 8       # max concurrently-running sequences
-    max_prefill_batch: int = 4    # max admissions coalesced into one prefill
+    max_prefill_batch: int = 4    # max requests coalesced into one prefill
     batch_buckets: tuple[int, ...] | None = None   # None -> pow2 ladder
     length_buckets: tuple[int, ...] | None = None  # None -> pow2 ladder
     eos_id: int | None = None
@@ -95,6 +118,13 @@ class EngineConfig:
     max_waiting: int = 128        # admission queue bound (overload beyond)
     max_waiting_blocks: int | None = None  # worst-case block budget queued
     step_timeout_s: float | None = None    # watchdog: wedged-step ceiling
+    prefix_caching: bool = True   # map prompts onto resident KV blocks
+    # Prefill one prompt in slices of at most this many tokens, alternating
+    # with decode steps. None -> the whole uncached suffix in one call (the
+    # monolithic PR 1 behavior for cold prompts).
+    prefill_chunk_tokens: int | None = None
+    admission_probe: int = 4      # skip-ahead width when the head won't fit
+    admission_max_skips: int = 16  # aging cap: stop skipping a starved head
 
 
 class TokenStream:
@@ -125,7 +155,8 @@ class TokenStream:
 class _Request:
     __slots__ = (
         "id", "prompt", "sampling", "out", "generated", "rng",
-        "reserved_blocks", "done", "deadline",
+        "reserved_blocks", "drawn_blocks", "prefill_done", "cached_tokens",
+        "started", "skips", "table_np", "table_key", "done", "deadline",
     )
 
     def __init__(self, req_id, prompt, sampling: SamplingParams):
@@ -140,6 +171,16 @@ class _Request:
             # draws resumes the stream exactly where the dead replica left it
             self.rng.random(sampling.start_index)
         self.reserved_blocks = 0
+        # blocks this request has consumed from its reservation so far:
+        # prefix-cache hits + appended blocks + copy-on-write copies. The
+        # leftover (reserved - drawn) is what eviction/completion releases.
+        self.drawn_blocks = 0
+        self.prefill_done = 0     # prompt tokens whose KV is resident
+        self.cached_tokens = 0    # of those, tokens served by prefix hits
+        self.started = False      # ran at least one prefill chunk
+        self.skips = 0            # admissions that jumped over this head
+        self.table_np: np.ndarray | None = None  # cached host block table
+        self.table_key: tuple | None = None      # (nb, table_version)
         self.done = False
         self.deadline = (
             time.monotonic() + sampling.deadline_s
@@ -155,14 +196,20 @@ class _Request:
 def _sample(logits: np.ndarray, sp: SamplingParams, rng) -> int:
     """Host-side sampling from one row of f32 logits.
 
-    Consumes exactly ONE uniform per token (inverse-CDF draw) — greedy
-    consumes none — so a request's RNG position is a pure function of how
-    many tokens it has produced. Mid-stream failover relies on this:
-    re-prefilling ``prompt + generated`` on a fresh engine with
+    Consumes exactly ONE uniform per token on every path — so a request's
+    RNG position is a pure function of how many tokens it has produced.
+    Mid-stream failover relies on this: re-prefilling
+    ``prompt + generated`` on a fresh engine with
     ``start_index=len(generated)`` reproduces the remaining tokens
     byte-identically.
+
+    Greedy (temperature <= 0) and top_k == 1 take a fast path: the token
+    is the argmax, so the softmax/cumsum work is skipped entirely — but
+    the uniform is still burned to keep the RNG contract uniform across
+    sampling configs.
     """
-    if sp.temperature <= 0.0:
+    u = rng.random()
+    if sp.temperature <= 0.0 or sp.top_k == 1:
         return int(np.argmax(logits))
     l = logits.astype(np.float64) / sp.temperature
     if sp.top_k > 0 and sp.top_k < l.shape[-1]:
@@ -171,10 +218,16 @@ def _sample(logits: np.ndarray, sp: SamplingParams, rng) -> int:
     l = l - l.max()
     p = np.exp(l)
     p /= p.sum()
-    u = rng.random()
     return int(
         min(np.searchsorted(np.cumsum(p), u, side="right"), l.shape[-1] - 1)
     )
+
+
+def _host_logits(logits) -> np.ndarray:
+    """The ONE device->host sync point on the emit path: materialize a
+    step's logits as f32 numpy for host-side sampling. All other engine
+    code must stay on-device (tests/test_sanitizers.py lints this)."""
+    return np.asarray(logits, np.float32)
 
 
 class LLMEngine:
@@ -247,6 +300,7 @@ class LLMEngine:
         self._work = threading.Condition(self._lock)
         self._waiting: deque[_Request] = deque()
         self._waiting_blocks = 0  # worst-case blocks held by the queue
+        self._prefilling: list[_Request] = []  # admitted, prefill incomplete
         self._running: list[_Request] = []
         self._next_id = 0
         self._auto_step = auto_step
@@ -262,6 +316,12 @@ class LLMEngine:
         self._rejected_total = 0
         self._cancelled_total = 0
         self._deadline_total = 0
+        self._prefill_tokens_total = 0  # tokens actually run through prefill
+        # "prefill" | "decode" | None — drives prefill/decode alternation
+        # and gives tests a step-order trace.
+        self.last_step_kind: str | None = None
+        # last cache-stat values already exported to the monotonic counters
+        self._exported = {"hit": 0, "evict": 0, "cow": 0, "prefill": 0}
 
         self._m_tokens = metrics.counter(
             "llm_engine_tokens_generated",
@@ -291,6 +351,22 @@ class LLMEngine:
         self._m_deadline = metrics.counter(
             "llm_deadline_exceeded",
             "Requests evicted because deadline_s expired mid-generation",
+        )
+        self._m_hit_tokens = metrics.counter(
+            "llm_prefix_hit_tokens",
+            "Prompt tokens served from the KV prefix cache (zero compute)",
+        )
+        self._m_evicted = metrics.counter(
+            "llm_prefix_evicted_blocks",
+            "Cached KV blocks evicted LRU to satisfy new allocations",
+        )
+        self._m_cow = metrics.counter(
+            "llm_cow_blocks",
+            "Copy-on-write block copies (writes into shared KV blocks)",
+        )
+        self._m_prefill_tokens = metrics.counter(
+            "llm_prefill_tokens",
+            "Prompt tokens actually computed by prefill (cache misses)",
         )
 
     # ---------------- public API ----------------
@@ -370,30 +446,42 @@ class LLMEngine:
         return list(stream)
 
     def step(self) -> bool:
-        """One scheduler iteration: expire deadlines, then a batched
-        prefill if any request can be admitted, else a batched decode
-        step. Returns False when idle."""
+        """One scheduler iteration: expire deadlines, admit what fits,
+        then EITHER one prefill chunk (new admissions or the next slice of
+        an in-flight prompt) OR one batched decode step. When both kinds
+        of work exist the scheduler alternates, so a long chunked prefill
+        never starves running sequences of decode steps. Returns False
+        when idle."""
         with self._lock:
             self._step_begin = time.perf_counter()
             try:
                 chaos.fire("engine.step")
                 self._expire_deadlines_locked()
-                admitted = self._admit_locked()
-                if admitted:
-                    self._prefill_locked(admitted)
+                self._admit_locked()
+                # Fresh admissions prefill immediately (first token out the
+                # door); CONTINUING chunks of a long prompt alternate with
+                # decode so running sequences are never starved.
+                if self._prefilling and (
+                    self.last_step_kind != "prefill"
+                    or not self._running
+                    or any(not r.started for r in self._prefilling)
+                ):
+                    self._prefill_chunk_locked()
+                    self.last_step_kind = "prefill"
                     return True
                 if self._running:
                     self._decode_locked()
+                    self.last_step_kind = "decode"
                     return True
                 return False
             finally:
                 self._step_begin = None
 
     def cancel(self, request_id) -> bool:
-        """Evict a waiting/running request, fail its stream with
-        ``RequestCancelledError``, and return its KV blocks immediately.
-        Returns False when the request is unknown or already finished
-        (idempotent — safe to broadcast to every replica)."""
+        """Evict a waiting/prefilling/running request, fail its stream
+        with ``RequestCancelledError``, and return its KV blocks
+        immediately. Returns False when the request is unknown or already
+        finished (idempotent — safe to broadcast to every replica)."""
         with self._lock:
             req = self._find_locked(request_id)
             if req is None:
@@ -409,16 +497,27 @@ class LLMEngine:
 
     def stats(self) -> dict:
         with self._lock:
+            cs = self.cache.stats
+            hit = cs.prefix_hit_tokens
+            computed = self._prefill_tokens_total
             return {
                 "waiting": len(self._waiting),
+                "prefilling": len(self._prefilling),
                 "running": len(self._running),
                 "kv_used_blocks": self.cache.used_blocks,
                 "kv_utilization": self.cache.utilization,
-                "kv_high_water_blocks": self.cache.stats.high_water_blocks,
+                "kv_high_water_blocks": cs.high_water_blocks,
                 "num_compiled_shapes": self.fns.num_compiled_shapes,
                 "rejected_total": self._rejected_total,
                 "cancelled_total": self._cancelled_total,
                 "deadline_exceeded_total": self._deadline_total,
+                "prefix_hit_tokens": hit,
+                "prefix_hit_blocks": cs.prefix_hit_blocks,
+                "prefix_cached_blocks": self.cache.cached_blocks,
+                "prefix_evicted_blocks": cs.prefix_evicted_blocks,
+                "cow_blocks": cs.cow_copies,
+                "prefill_tokens_total": computed,
+                "prefix_hit_rate": hit / max(1, hit + computed),
                 "failed": self._failed is not None,
             }
 
@@ -432,14 +531,15 @@ class LLMEngine:
 
     def shutdown(self) -> None:
         """Stop stepping, fail every pending stream with a clear error,
-        and return ALL KV blocks (allocations and reservations) to the
-        pool — repeated create/shutdown in one process is leak-free."""
+        and return ALL KV blocks (allocations, reservations, and the
+        prefix cache) to the pool — repeated create/shutdown in one
+        process is leak-free."""
         with self._lock:
             if self._stopped:
                 return
             self._stopped = True
             err = RequestCancelledError("engine shut down")
-            for r in list(self._waiting) + self._running:
+            for r in list(self._waiting) + self._prefilling + self._running:
                 if not r.done:
                     r.done = True
                     r.out.put(err)
@@ -447,6 +547,7 @@ class LLMEngine:
             self.cache.release_all()
             self._waiting.clear()
             self._waiting_blocks = 0
+            self._prefilling.clear()
             self._running.clear()
             self._m_queue.set(0)
             self._m_util.set(self.cache.utilization)
@@ -463,6 +564,9 @@ class LLMEngine:
         for r in self._running:
             if r.id == request_id:
                 return r
+        for r in self._prefilling:
+            if r.id == request_id:
+                return r
         for r in self._waiting:
             if r.id == request_id:
                 return r
@@ -470,11 +574,14 @@ class LLMEngine:
 
     def _evict_locked(self, r: _Request) -> None:
         """Remove a live request from the scheduler and return its blocks
-        (allocation + leftover reservation for running; queued worst-case
+        (allocation + leftover reservation for admitted; queued worst-case
         budget for waiting). Does NOT touch the output stream."""
-        if r in self._running:
-            self._running.remove(r)
-            leftover = r.reserved_blocks - self.cache.num_allocated(r.id)
+        if r in self._running or r in self._prefilling:
+            if r in self._running:
+                self._running.remove(r)
+            else:
+                self._prefilling.remove(r)
+            leftover = r.reserved_blocks - r.drawn_blocks
             self.cache.free(r.id)
             if leftover > 0:
                 self.cache.release_reservation(leftover)
@@ -496,7 +603,7 @@ class LLMEngine:
         now = time.monotonic()
         for r in [
             r
-            for r in list(self._waiting) + self._running
+            for r in list(self._waiting) + self._prefilling + self._running
             if r.deadline is not None and now >= r.deadline
         ]:
             self._evict_locked(r)
@@ -511,58 +618,199 @@ class LLMEngine:
             )
             r.out.put(_DONE)
 
-    def _admit_locked(self) -> list[_Request]:
-        admitted: list[_Request] = []
-        while (
-            self._waiting
-            and len(self._running) + len(admitted) < self.cfg.max_batch_size
-            and len(admitted) < self.cfg.max_prefill_batch
-        ):
-            req = self._waiting[0]
-            need = self.cache.cfg.blocks_for(
-                len(req.prompt) + req.sampling.max_new_tokens
-            )
-            if not self.cache.can_reserve(need):
-                break  # blocks free up when a running sequence completes
-            self.cache.reserve(need)
-            req.reserved_blocks = need
-            admitted.append(self._waiting.popleft())
-            self._waiting_blocks -= need
-        if admitted:
-            self._m_queue.set(len(self._waiting))
-        return admitted
+    def _try_admit_one_locked(self, req: _Request) -> bool:
+        """Reserve worst-case blocks for one request, allocate its table,
+        and map its resident prompt prefix. Returns False (no state
+        change) when the reservation doesn't fit right now.
 
-    def _prefill_locked(self, admitted: list[_Request]) -> None:
+        Reservation sizing: ``blocks_for(prompt + max_new_tokens)``, plus
+        ONE extra block when the ENTIRE prompt is resident — the last
+        prompt token must still be recomputed to produce first-token
+        logits, and that write lands in a shared hashed block, so it
+        always triggers exactly one copy-on-write copy."""
+        bs = self.cfg.block_size
+        total = len(req.prompt) + req.sampling.max_new_tokens
+        need = self.cache.cfg.blocks_for(total)
+        max_hit_blocks = None
+        if self.cfg.prefix_caching:
+            hit_blocks = self.cache.peek_prefix(req.prompt)
+            if hit_blocks * bs >= len(req.prompt):  # full-prompt hit
+                if (
+                    need + 1 <= self.cache.cfg.usable_blocks
+                    and self.cache.can_reserve(need + 1)
+                ):
+                    need += 1
+                    max_hit_blocks = hit_blocks
+                elif self.cache.can_reserve(need):
+                    # no headroom for the COW copy: drop the last hit
+                    # block and recompute it instead
+                    max_hit_blocks = hit_blocks - 1
+                else:
+                    return False
+            else:
+                if not self.cache.can_reserve(need):
+                    return False
+                max_hit_blocks = hit_blocks
+        elif not self.cache.can_reserve(need):
+            return False
+        self.cache.reserve(need)
+        req.reserved_blocks = need
+        self.cache.allocate(req.id)
+        if self.cfg.prefix_caching:
+            hit_tokens = self.cache.assign_prefix(
+                req.id, req.prompt, max_blocks=max_hit_blocks
+            )
+            req.drawn_blocks += hit_tokens // bs
+            # a full-prompt hit still recomputes the LAST prompt token (a
+            # 1-token chunk) so the engine has logits to sample from
+            req.prefill_done = min(hit_tokens, len(req.prompt) - 1)
+            req.cached_tokens = req.prefill_done
+        return True
+
+    def _admit_locked(self) -> None:
+        """Move waiting requests into the prefilling set. FIFO first; when
+        the head's reservation doesn't fit, probe up to
+        ``admission_probe`` requests behind it — unless the head has
+        already been skipped ``admission_max_skips`` times, in which case
+        admission stalls until the head fits (no starvation)."""
+        admitted = 0
+        if not self._waiting:
+            return
+        head = self._waiting[0]
+        probe_budget = (
+            self.cfg.admission_probe
+            if head.skips < self.cfg.admission_max_skips
+            else 0
+        )
+        probed = 0
+        idx = 0
+        while (
+            idx < len(self._waiting)
+            and len(self._running) + len(self._prefilling)
+            < self.cfg.max_batch_size
+            and admitted < self.cfg.max_prefill_batch
+        ):
+            req = self._waiting[idx]
+            if self._try_admit_one_locked(req):
+                del self._waiting[idx]
+                self._waiting_blocks -= self.cache.cfg.blocks_for(
+                    len(req.prompt) + req.sampling.max_new_tokens
+                )
+                self._prefilling.append(req)
+                admitted += 1
+            else:
+                if probed >= probe_budget:
+                    break
+                probed += 1
+                idx += 1
+        if admitted:
+            if head in self._waiting:
+                head.skips += 1  # someone was admitted past the head
+            self._m_queue.set(len(self._waiting))
+
+    def _table_for(self, r: _Request, nb: int) -> np.ndarray:
+        """Host block table for one request, rebuilt only when a block was
+        appended/replaced (version bump) or the padded width changed."""
+        key = (nb, self.cache.table_version(r.id))
+        if r.table_key != key:
+            r.table_np = self.cache.block_table(r.id, nb)
+            r.table_key = key
+        return r.table_np
+
+    def _apply_copies_locked(self, pairs: list[tuple[int, int]]) -> None:
+        """Clone shared blocks on device (COW) before a write lands. The
+        (src, dst) list pads to a pow2 bucket with (0, 0) — copying the
+        garbage block onto itself — so the jitted shape set stays
+        closed."""
+        if not pairs:
+            return
         import jax.numpy as jnp
 
-        chaos.fire("engine.prefill", batch=len(admitted))
+        from ray_tpu.ops.kv_cache import copy_blocks
+
+        width = 1 << (len(pairs) - 1).bit_length()
+        src = np.zeros((width,), np.int32)
+        dst = np.zeros((width,), np.int32)
+        for i, (s, d) in enumerate(pairs):
+            src[i] = s
+            dst[i] = d
+        self.cache.k, self.cache.v = copy_blocks(
+            self.cache.k, self.cache.v, jnp.asarray(src), jnp.asarray(dst)
+        )
+
+    def _prefill_chunk_locked(self) -> None:
+        """Run ONE prefill call for up to ``max_prefill_batch`` admitted
+        requests: each contributes its next chunk (the whole uncached
+        suffix when ``prefill_chunk_tokens`` is None). Cold whole prompts
+        take the monolithic reference path (start=None) — identical
+        numerics and compile signatures to PR 1; anything mid-prompt or
+        prefix-seeded takes the paged chunk path at true positions."""
+        import jax.numpy as jnp
+
+        batch = self._prefilling[: self.cfg.max_prefill_batch]
+        chaos.fire("engine.prefill", batch=len(batch))
         t0 = time.perf_counter()
         bs = self.cfg.block_size
-        for r in admitted:
-            self.cache.allocate(r.id)
-            self.cache.ensure_capacity(r.id, len(r.prompt))
-        S = pad_to_bucket(
-            max(len(r.prompt) for r in admitted), self._length_buckets
+        cap = self.cfg.prefill_chunk_tokens
+        ns = []
+        for r in batch:
+            r.started = True
+            remaining = len(r.prompt) - r.prefill_done
+            ns.append(remaining if cap is None else min(remaining, cap))
+        pairs: list[tuple[int, int]] = []
+        for r, n in zip(batch, ns):
+            appended = self.cache.ensure_capacity(r.id, r.prefill_done + n)
+            r.drawn_blocks += appended
+            cow = self.cache.prepare_write(
+                r.id, r.prefill_done, r.prefill_done + n
+            )
+            r.drawn_blocks += len(cow)
+            pairs.extend(cow)
+        self._apply_copies_locked(pairs)
+
+        legacy = all(
+            r.prefill_done == 0 and n == len(r.prompt)
+            for r, n in zip(batch, ns)
         )
-        B = pad_to_bucket(len(admitted), self._batch_buckets)
-        nb = S // bs
+        S = pad_to_bucket(max(ns), self._length_buckets)
+        B = pad_to_bucket(len(batch), self._batch_buckets)
+        if legacy:
+            nb = S // bs
+        else:
+            ctx = pad_to_bucket(
+                max(r.prefill_done + n for r, n in zip(batch, ns)),
+                self._length_buckets,
+            )
+            nb = ctx // bs
         tokens = np.zeros((B, S), np.int32)
         lengths = np.ones((B,), np.int32)  # padding rows: length 1
+        starts = np.zeros((B,), np.int32)
         tables = np.zeros((B, nb), np.int32)
-        for i, r in enumerate(admitted):
-            tokens[i, : len(r.prompt)] = r.prompt
-            lengths[i] = len(r.prompt)
-            tables[i] = self.cache.block_table(r.id, nb)
+        for i, (r, n) in enumerate(zip(batch, ns)):
+            tokens[i, :n] = r.prompt[r.prefill_done : r.prefill_done + n]
+            lengths[i] = n
+            starts[i] = r.prefill_done
+            tables[i] = self._table_for(r, nb)
         logits, self.cache.k, self.cache.v = self.fns.prefill(
             self.params, self.cache.k, self.cache.v,
             jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(tables),
+            start=None if legacy else jnp.asarray(starts),
         )
-        logits = np.asarray(logits, np.float32)
-        for i, r in enumerate(admitted):
-            self._emit_locked(r, logits[i])
-            if not r.done:
-                self._running.append(r)
+        host = _host_logits(logits)
+        for i, (r, n) in enumerate(zip(batch, ns)):
+            r.prefill_done += n
+            self._prefill_tokens_total += n
+            if self.cfg.prefix_caching:
+                self.cache.register_prefix(r.id, r.prompt, r.prefill_done)
+            if r.prefill_done >= len(r.prompt):
+                self._prefilling.remove(r)
+                # the model returns last-VALID-token logits per row — for
+                # the final chunk that is the last prompt token
+                self._emit_locked(r, host[i])
+                if not r.done:
+                    self._running.append(r)
         self._m_util.set(self.cache.utilization)
+        self._sync_cache_counters_locked()
         self._m_latency.observe(
             time.perf_counter() - t0, tags={"kind": "prefill"}
         )
@@ -574,8 +822,14 @@ class LLMEngine:
         t0 = time.perf_counter()
         bs = self.cfg.block_size
         batch = list(self._running)
+        pairs: list[tuple[int, int]] = []
         for r in batch:
-            self.cache.ensure_capacity(r.id, r.total_len)
+            appended = self.cache.ensure_capacity(r.id, r.total_len)
+            r.drawn_blocks += appended
+            cow = self.cache.prepare_write(r.id, r.total_len - 1, r.total_len)
+            r.drawn_blocks += len(cow)
+            pairs.extend(cow)
+        self._apply_copies_locked(pairs)
         B = pad_to_bucket(len(batch), self._batch_buckets)
         ctx = pad_to_bucket(
             max(r.total_len for r in batch), self._length_buckets
@@ -587,16 +841,17 @@ class LLMEngine:
         for i, r in enumerate(batch):
             tokens[i] = r.generated[-1] if r.generated else r.prompt[-1]
             positions[i] = r.total_len - 1
-            tables[i] = self.cache.block_table(r.id, nb)
+            tables[i] = self._table_for(r, nb)
         logits, self.cache.k, self.cache.v = self.fns.decode(
             self.params, self.cache.k, self.cache.v,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
         )
-        logits = np.asarray(logits, np.float32)
+        host = _host_logits(logits)
         for i, r in enumerate(batch):
-            self._emit_locked(r, logits[i])
+            self._emit_locked(r, host[i])
         self._running = [r for r in self._running if not r.done]
         self._m_util.set(self.cache.utilization)
+        self._sync_cache_counters_locked()
         self._m_latency.observe(
             time.perf_counter() - t0, tags={"kind": "decode"}
         )
@@ -613,13 +868,28 @@ class LLMEngine:
             self._complete_locked(r)
 
     def _complete_locked(self, r: _Request) -> None:
-        leftover = r.reserved_blocks - self.cache.num_allocated(r.id)
+        leftover = r.reserved_blocks - r.drawn_blocks
         self.cache.free(r.id)
         if leftover > 0:
             self.cache.release_reservation(leftover)
         r.done = True
         r.out.put(_DONE)
         self._work.notify_all()  # freed blocks may unblock admissions
+
+    def _sync_cache_counters_locked(self) -> None:
+        """Export cache-stat deltas to the monotonic Prometheus counters
+        (cache stats are plain ints; counters are process-shared)."""
+        cs = self.cache.stats
+        for key, value, counter in (
+            ("hit", cs.prefix_hit_tokens, self._m_hit_tokens),
+            ("evict", cs.prefix_evicted_blocks, self._m_evicted),
+            ("cow", cs.cow_copies, self._m_cow),
+            ("prefill", self._prefill_tokens_total, self._m_prefill_tokens),
+        ):
+            delta = value - self._exported[key]
+            if delta > 0:
+                counter.inc(delta)
+                self._exported[key] = value
 
     # ---------------- failure handling ----------------
 
@@ -637,13 +907,14 @@ class LLMEngine:
             self._fan_out_failure(err)
 
     def _fan_out_failure(self, err: EngineDiedError) -> None:
-        for r in list(self._waiting) + list(self._running):
+        for r in list(self._waiting) + self._prefilling + self._running:
             if not r.done:
                 r.done = True
                 r.out.put(err)
                 r.out.put(_DONE)
         self._waiting.clear()
         self._waiting_blocks = 0
+        self._prefilling = []
         self._running = []
         self.cache.release_all()
 
@@ -683,6 +954,7 @@ class LLMEngine:
                     if (
                         not self._stopped
                         and not self._waiting
+                        and not self._prefilling
                         and not self._running
                     ):
                         self._work.wait(timeout=0.05)
@@ -704,7 +976,9 @@ class LLMEngine:
                     "failing all in-flight streams"
                 )
                 self._failed = err
-                for r in list(self._waiting) + list(self._running):
+                for r in (
+                    list(self._waiting) + self._prefilling + self._running
+                ):
                     if not r.done:
                         r.done = True
                         r.out.put(err)

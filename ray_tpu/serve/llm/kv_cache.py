@@ -1,4 +1,5 @@
-"""Paged KV-cache manager: preallocated block pool + per-sequence tables.
+"""Paged KV-cache manager: preallocated block pool + per-sequence tables
++ block-granular prefix cache (content-addressed blocks, COW, LRU evict).
 
 vLLM-style paging (PAPERS.md: serving Gemma on Cloud TPU uses the same
 structure): the cache is ONE preallocated array pair per model —
@@ -19,13 +20,45 @@ WORST-CASE block count (prompt + max_new_tokens) before prefill, so a
 running sequence can never fail a mid-flight append — the simple analog of
 vLLM's preemption machinery, traded for a little capacity headroom
 (docs/SERVING_LLM.md discusses the trade).
+
+Prefix caching (the SGLang RadixAttention idea at block granularity):
+every FULL prompt block is content-addressed by the chain hash of all
+token ids up to and including it, so a new request whose prompt shares a
+prefix with earlier traffic maps the shared blocks into its table instead
+of recomputing their K/V. A block is then in one of three states:
+
+  free        in ``_free``          — no meaningful content
+  referenced  refcount >= 1         — mapped by one or more live tables
+  cached      in ``_lru``           — refcount 0 but content-addressed;
+                                      resurrectable by a future hit,
+                                      evicted LRU when ``_free`` runs dry
+
+Writes never land in a content-addressed or shared block: ``prepare_write``
+redirects them copy-on-write onto a fresh private block (the device-side
+clone is ``ops.kv_cache.copy_blocks``). Reservations draw uniformly from
+hits, appends and COW copies, so the no-mid-flight-failure invariant is
+unchanged; ``release_all`` also drops the content-addressed set, keeping
+engine create/shutdown cycles leak-free.
 """
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any
 
 import numpy as np
+
+
+def _block_key(prev: bytes, block_tokens) -> bytes:
+    """Chain hash for one full block: digest of (parent digest, the
+    block's token ids). Identifying a block by the chain rather than its
+    own tokens makes equal-content blocks at different prompt offsets
+    distinct — a hit therefore always means 'same tokens from position
+    0', never a mid-prompt coincidence."""
+    h = hashlib.blake2b(prev, digest_size=16)
+    h.update(np.asarray(block_tokens, np.int64).tobytes())
+    return h.digest()
 
 
 @dataclass(frozen=True)
@@ -50,6 +83,10 @@ class CacheStats:
     high_water_blocks: int = 0
     allocated_total: int = 0
     freed_total: int = 0
+    prefix_hit_blocks: int = 0
+    prefix_hit_tokens: int = 0
+    prefix_evicted_blocks: int = 0
+    cow_copies: int = 0
     tables: dict = field(default_factory=dict)
 
 
@@ -75,18 +112,34 @@ class PagedKVCache:
         self._free: list[int] = list(range(1, cfg.num_blocks))
         self._tables: dict[Any, list[int]] = {}
         self._reserved = 0
+        # prefix cache state
+        self._ref: dict[int, int] = {}            # block -> live references
+        self._lru: OrderedDict[int, None] = OrderedDict()  # refcount-0 cached
+        self._hash_to_block: dict[bytes, int] = {}
+        self._block_hash: dict[int, bytes] = {}
+        # seq -> (chain digest so far, number of blocks hashed into it)
+        self._chain: dict[Any, tuple[bytes, int]] = {}
+        # bumped whenever a sequence's table CONTENT changes (append / COW /
+        # prefix mapping) — lets the engine cache host-side numpy tables
+        self._versions: dict[Any, int] = {}
         self.stats = CacheStats()
 
     # ---------------- reservation (admission control) ----------------
 
+    @property
+    def available_blocks(self) -> int:
+        """Blocks an admission may claim: truly free + evictable cached."""
+        return len(self._free) + len(self._lru)
+
     def can_reserve(self, n_blocks: int) -> bool:
-        return n_blocks <= len(self._free) - self._reserved
+        return n_blocks <= self.available_blocks - self._reserved
 
     def reserve(self, n_blocks: int) -> None:
         if not self.can_reserve(n_blocks):
             raise RuntimeError(
                 f"cannot reserve {n_blocks} blocks: "
-                f"{len(self._free)} free, {self._reserved} already reserved"
+                f"{self.available_blocks} available "
+                f"({len(self._lru)} cached), {self._reserved} already reserved"
             )
         self._reserved += n_blocks
 
@@ -101,46 +154,209 @@ class PagedKVCache:
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
         self._tables[seq_id] = []
+        self._chain[seq_id] = (b"", 0)
+        self._versions[seq_id] = 0
 
-    def ensure_capacity(self, seq_id, num_tokens: int, *, reserved=True):
+    def _take_block(self, *, reserved: bool) -> int:
+        """Claim one writable block: from the free list, else by evicting
+        the LRU-oldest content-addressed block (its hash entry dies)."""
+        if self._free:
+            b = self._free.pop()
+        elif self._lru:
+            b, _ = self._lru.popitem(last=False)  # oldest first
+            h = self._block_hash.pop(b)
+            del self._hash_to_block[h]
+            self.stats.prefix_evicted_blocks += 1
+        else:
+            raise RuntimeError(
+                "KV block pool exhausted — reservation accounting bug"
+            )
+        if reserved:
+            self._reserved -= 1
+        self.stats.allocated_total += 1
+        return b
+
+    def ensure_capacity(self, seq_id, num_tokens: int, *, reserved=True) -> int:
         """Append blocks until the sequence can hold ``num_tokens``.
-        Draws from this sequence's reservation when ``reserved``."""
+        Draws from this sequence's reservation when ``reserved``.
+        Returns the number of blocks appended."""
         table = self._tables[seq_id]
+        appended = 0
         while len(table) * self.cfg.block_size < num_tokens:
-            if not self._free:
-                raise RuntimeError(
-                    "KV block pool exhausted — reservation accounting bug"
-                )
-            table.append(self._free.pop())
-            if reserved:
-                self._reserved -= 1
-            self.stats.allocated_total += 1
-        self.stats.high_water_blocks = max(
-            self.stats.high_water_blocks, self.used_blocks
-        )
+            b = self._take_block(reserved=reserved)
+            self._ref[b] = 1
+            table.append(b)
+            appended += 1
+        if appended:
+            self._versions[seq_id] += 1
+            self.stats.high_water_blocks = max(
+                self.stats.high_water_blocks, self.used_blocks
+            )
+        return appended
+
+    def _deref(self, b: int) -> None:
+        self._ref[b] -= 1
+        if self._ref[b] == 0:
+            del self._ref[b]
+            if b in self._block_hash:
+                # content survives, resurrectable until evicted
+                self._lru[b] = None  # appended at the MRU end
+            else:
+                self._free.append(b)
 
     def free(self, seq_id) -> int:
-        """Return a finished sequence's blocks to the pool; -> count."""
+        """Drop a finished sequence's references; -> table length. Blocks
+        it shared with live sequences stay put; sole-owned blocks return
+        to the free list, except content-addressed ones, which park in the
+        LRU set (still resurrectable by a future prefix hit)."""
         table = self._tables.pop(seq_id)
-        self._free.extend(reversed(table))  # LIFO: newest block reused first
+        self._chain.pop(seq_id, None)
+        self._versions.pop(seq_id, None)
+        for b in reversed(table):  # LIFO: newest block reused first
+            self._deref(b)
         self.stats.freed_total += len(table)
         return len(table)
 
     def release_all(self) -> int:
-        """Free every sequence and drop all reservations (engine failure /
-        shutdown path); -> blocks returned. Afterwards the free list is
-        full again, so repeated engine create/shutdown cannot leak."""
+        """Free every sequence, drop all reservations AND the whole prefix
+        cache (engine failure / shutdown path); -> blocks returned.
+        Afterwards the free list is full again, so repeated engine
+        create/shutdown cannot leak."""
         returned = 0
         for seq_id in list(self._tables):
             returned += self.free(seq_id)
+        self._free.extend(self._lru)
+        self._lru.clear()
+        self._hash_to_block.clear()
+        self._block_hash.clear()
         self._reserved = 0
         return returned
+
+    # ---------------- prefix cache ----------------
+
+    def peek_prefix(self, tokens) -> int:
+        """Number of LEADING full blocks of ``tokens`` currently resident
+        (referenced or cached) — a pure lookup, no state change. The
+        engine uses it to size the reservation before committing."""
+        digest = b""
+        bs = self.cfg.block_size
+        hits = 0
+        for i in range(len(tokens) // bs):
+            digest = _block_key(digest, tokens[i * bs:(i + 1) * bs])
+            if digest not in self._hash_to_block:
+                break
+            hits += 1
+        return hits
+
+    def assign_prefix(self, seq_id, tokens, max_blocks: int | None = None) -> int:
+        """Map the longest resident prefix of ``tokens`` (full blocks
+        only, at most ``max_blocks``) into ``seq_id``'s table, taking one
+        reference per block. Each mapped block draws one unit from the
+        reservation — identical accounting to an append, so the caller's
+        worst-case reservation covers hits and computes uniformly.
+        Returns the number of PROMPT TOKENS covered (hits * block_size).
+        Must run right after ``allocate`` (empty table)."""
+        table = self._tables[seq_id]
+        assert not table, "assign_prefix requires an empty table"
+        digest = b""
+        bs = self.cfg.block_size
+        limit = len(tokens) // bs
+        if max_blocks is not None:
+            limit = min(limit, max_blocks)
+        hits = 0
+        for i in range(limit):
+            nxt = _block_key(digest, tokens[i * bs:(i + 1) * bs])
+            b = self._hash_to_block.get(nxt)
+            if b is None:
+                break
+            if b in self._lru:  # resurrect: cached -> referenced
+                del self._lru[b]
+                self._ref[b] = 1
+            else:
+                self._ref[b] += 1
+            table.append(b)
+            self._reserved -= 1
+            digest = nxt
+            hits += 1
+        if hits:
+            self._chain[seq_id] = (digest, hits)
+            self._versions[seq_id] += 1
+            self.stats.prefix_hit_blocks += hits
+            self.stats.prefix_hit_tokens += hits * bs
+            self.stats.high_water_blocks = max(
+                self.stats.high_water_blocks, self.used_blocks
+            )
+        return hits * bs
+
+    def register_prefix(self, seq_id, tokens, upto_tokens: int) -> int:
+        """Content-address ``seq_id``'s full prompt blocks whose tokens
+        [0, upto_tokens) are now fully written (engine calls this after
+        each prefill chunk). Blocks whose chain hash is already claimed
+        (a concurrent identical prompt) stay private. -> newly registered
+        block count."""
+        digest, hashed = self._chain[seq_id]
+        table = self._tables[seq_id]
+        bs = self.cfg.block_size
+        nfull = min(upto_tokens // bs, len(tokens) // bs, len(table))
+        registered = 0
+        while hashed < nfull:
+            digest = _block_key(
+                digest, tokens[hashed * bs:(hashed + 1) * bs]
+            )
+            b = table[hashed]
+            if digest not in self._hash_to_block and b not in self._block_hash:
+                self._hash_to_block[digest] = b
+                self._block_hash[b] = digest
+                registered += 1
+            hashed += 1
+        self._chain[seq_id] = (digest, hashed)
+        return registered
+
+    def prepare_write(self, seq_id, start_pos: int, end_pos: int,
+                      *, reserved=True) -> list[tuple[int, int]]:
+        """Make positions [start_pos, end_pos) of ``seq_id`` writable.
+        Any already-allocated block in that range that is shared
+        (refcount > 1) or content-addressed gets a fresh private block in
+        the table; the returned (src, dst) pairs must be applied on device
+        with ``ops.kv_cache.copy_blocks`` BEFORE the write lands. The
+        shared source keeps its hash entry (and its other readers), so a
+        sequence appending into a shared tail block diverges without
+        corrupting the cached prefix."""
+        if end_pos <= start_pos:
+            return []
+        table = self._tables[seq_id]
+        bs = self.cfg.block_size
+        lo = start_pos // bs
+        hi = min(len(table) - 1, (end_pos - 1) // bs)
+        pairs: list[tuple[int, int]] = []
+        for idx in range(lo, hi + 1):
+            b = table[idx]
+            if self._ref.get(b, 0) > 1 or b in self._block_hash:
+                dst = self._take_block(reserved=reserved)
+                self._ref[dst] = 1
+                table[idx] = dst
+                self._deref(b)
+                pairs.append((b, dst))
+                self.stats.cow_copies += 1
+        if pairs:
+            self._versions[seq_id] += 1
+            self.stats.high_water_blocks = max(
+                self.stats.high_water_blocks, self.used_blocks
+            )
+        return pairs
 
     # ---------------- views ----------------
 
     @property
     def used_blocks(self) -> int:
-        return self.cfg.usable_blocks - len(self._free)
+        """Blocks referenced by live tables (cached-but-unreferenced
+        blocks are reclaimable, so they don't count as used)."""
+        return self.cfg.usable_blocks - len(self._free) - len(self._lru)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Content-addressed blocks with no live reference (the LRU set)."""
+        return len(self._lru)
 
     @property
     def utilization(self) -> float:
@@ -158,6 +374,11 @@ class PagedKVCache:
         out = np.zeros((pad_to,), np.int32)
         out[: len(table)] = table
         return out
+
+    def table_version(self, seq_id) -> int:
+        """Monotonic per-sequence counter, bumped on any table-content
+        change — cache key for host-side materialized block tables."""
+        return self._versions[seq_id]
 
     def num_allocated(self, seq_id) -> int:
         return len(self._tables[seq_id])

@@ -137,11 +137,17 @@ def start_metrics_server(port: int = 9090) -> None:
     _prom.start_http_server(port, registry=_get_registry())
 
 
-def collect() -> dict[str, float]:
-    """Programmatic snapshot: {'name{label=v}': value} for tests/inspection."""
+def collect(prefix: str | None = None) -> dict[str, float]:
+    """Programmatic snapshot: {'name{label=v}': value} for tests/inspection.
+
+    ``prefix`` filters by sample-name prefix (e.g. ``"llm_prefix"``) so
+    benchmarks and dashboards can pull one subsystem's metrics without
+    walking the whole registry."""
     out = {}
     for family in _get_registry().collect():
         for sample in family.samples:
+            if prefix is not None and not sample.name.startswith(prefix):
+                continue
             labels = ",".join(f"{k}={v}" for k, v in sorted(sample.labels.items()))
             key = f"{sample.name}{{{labels}}}" if labels else sample.name
             out[key] = sample.value

@@ -122,6 +122,65 @@ def test_no_bare_except_in_serving_path():
     assert not offenders, f"bare except clauses: {offenders}"
 
 
+def test_device_logits_cross_host_only_on_emit_path():
+    """Serving-perf lint (ISSUE 3): device logits must cross to host
+    exactly once per step, on the emit path (``_host_logits`` in
+    engine.py). A stray ``np.asarray(logits...)`` anywhere else in
+    serve/llm re-introduces a hidden device sync (and an extra
+    transfer) in the scheduler hot loop."""
+    import ast
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    targets = sorted((root / "ray_tpu" / "serve" / "llm").rglob("*.py"))
+    assert targets, "serving path sources not found"
+
+    def mentions_logits(node: ast.AST) -> bool:
+        return any(
+            isinstance(sub, ast.Name) and "logits" in sub.id
+            for sub in ast.walk(node)
+        )
+
+    offenders = []
+    for path in targets:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        # map each node to its enclosing function name
+        parents: dict[ast.AST, str] = {}
+
+        def tag(node, fn):
+            for child in ast.iter_child_nodes(node):
+                name = fn
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    name = child.name
+                parents[child] = name
+                tag(child, name)
+
+        tag(tree, "<module>")
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_asarray = (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("asarray", "array")
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "np"
+            )
+            if not is_asarray or not node.args:
+                continue
+            if not mentions_logits(node.args[0]):
+                continue
+            fn = parents.get(node, "<module>")
+            if path.name == "engine.py" and fn == "_host_logits":
+                continue  # THE emit-path sync point
+            offenders.append(f"{path.relative_to(root)}:{node.lineno} ({fn})")
+    assert not offenders, (
+        f"device logits pulled to host outside the emit path: {offenders}"
+    )
+
+
 SCHED_DRIVER = r"""
 #include <cstdint>
 #include <cstdio>

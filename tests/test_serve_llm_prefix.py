@@ -1,0 +1,387 @@
+"""Prefix-cached paged KV + chunked prefill (ray_tpu.serve.llm).
+
+The PR 3 serving optimizations, pinned at the engine level:
+
+(a) prefix-cache hit path — byte-identical tokens to the cold path with
+    >= 2x less prefill compute on shared-prefix traffic, hit/evict
+    accounting in ``engine.stats()``
+(b) copy-on-write — full-prompt hits append through a shared tail block
+    without corrupting the cached prefix for later requests
+(c) refcount hygiene — cancel / release_all / shutdown leave the pool
+    clean (no leaked blocks or reservations) with the cache populated
+(d) chunked prefill — parity with monolithic prefill, decode interleave
+    (step-order trace), and the compile-shape set stays bounded
+(e) greedy fast path — still exactly one RNG uniform per token, so
+    failover resume identity holds for every sampling config
+(f) admission skip-ahead — small requests admit past a too-big head,
+    bounded by the aging cap so the head cannot starve
+(g) LRU eviction — unreferenced cached blocks are evicted when the free
+    list runs dry; just-registered prefixes stay resident (MRU)
+
+Parity tests run f32 + XLA attention (same rationale as
+tests/test_serve_llm.py): cold monolithic prefill, chunked prefill, and
+decode use different-but-equivalent attention formulations, and token
+argmax/sampling must agree across them.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+
+def _model_config():
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig
+
+    return dataclasses.replace(
+        LlamaConfig.tiny(), dtype=jnp.float32, attention="xla"
+    )
+
+
+def _engine(mc, *, auto_step=False, **kw):
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 64)
+    return LLMEngine(
+        EngineConfig(model="llama", model_config=mc, **kw), auto_step=auto_step
+    )
+
+
+def _pool_is_clean(eng) -> bool:
+    """No live blocks, no reservations. Cached (LRU) blocks are fine —
+    they are reclaimable — so clean means free + cached == usable."""
+    c = eng.cache
+    return (
+        len(c._free) + len(c._lru) == c.cfg.usable_blocks
+        and c._reserved == 0
+        and c.used_blocks == 0
+    )
+
+
+def _shared_prefix(n=64):
+    rng = np.random.default_rng(42)
+    return [int(t) for t in rng.integers(1, 250, size=n)]
+
+
+# ------------------------------------------------------- (a) hit path
+
+@pytest.mark.timeout(300)
+def test_prefix_hits_byte_identical_with_2x_less_prefill_compute(jax_cpu):
+    """16 requests sharing a 64-token prefix: tokens identical to the
+    caching-off engine, total prefill compute >= 2x lower, and stats
+    report the hit/evict counts (acceptance criterion)."""
+    mc = _model_config()
+    prefix = _shared_prefix(64)
+    prompts = [prefix + [i + 1, i + 2, i + 3] for i in range(16)]
+
+    cold_eng = _engine(mc, prefix_caching=False)
+    cold = [cold_eng.generate(p, max_new_tokens=6) for p in prompts]
+    cold_tokens = cold_eng.stats()["prefill_tokens_total"]
+    assert cold_tokens == sum(len(p) for p in prompts)
+
+    warm_eng = _engine(mc)
+    warm = [warm_eng.generate(p, max_new_tokens=6) for p in prompts]
+    st = warm_eng.stats()
+
+    assert warm == cold, "prefix-cache hits must not change outputs"
+    assert st["prefill_tokens_total"] * 2 <= cold_tokens, (
+        f"expected >= 2x prefill-compute drop: computed "
+        f"{st['prefill_tokens_total']} vs cold {cold_tokens}"
+    )
+    assert st["prefix_hit_tokens"] >= 15 * 64
+    assert st["prefix_hit_blocks"] >= 15 * 8
+    assert st["prefix_hit_rate"] > 0.5
+    assert st["prefix_cached_blocks"] > 0   # prefix stays resident
+    assert st["prefix_evicted_blocks"] == 0  # pool never ran dry here
+    assert st["kv_used_blocks"] == 0
+    assert _pool_is_clean(warm_eng)
+
+
+@pytest.mark.timeout(300)
+def test_prefix_metrics_exported(jax_cpu):
+    from ray_tpu.util import metrics
+
+    mc = _model_config()
+    prefix = _shared_prefix(32)
+    eng = _engine(mc)
+    before = metrics.collect(prefix="llm_prefix").get(
+        "llm_prefix_hit_tokens_total", 0
+    )
+    eng.generate(prefix + [7], max_new_tokens=2)
+    eng.generate(prefix + [9], max_new_tokens=2)
+    snap = metrics.collect(prefix="llm_")
+    assert snap["llm_prefix_hit_tokens_total"] >= before + 32
+    assert "llm_prefix_evicted_blocks_total" in snap
+    assert "llm_cow_blocks_total" in snap
+    assert snap["llm_prefill_tokens_total"] > 0
+    # the prefix filter really filters
+    assert all(k.startswith("llm_") for k in snap)
+
+
+# ------------------------------------------------------- (b) COW
+
+@pytest.mark.timeout(300)
+def test_full_prompt_hit_copy_on_write_divergence(jax_cpu):
+    """A prompt that is ENTIRELY resident (length % block_size == 0)
+    still yields correct tokens: the last prompt token is recomputed
+    through a copy-on-write clone of the shared tail block, and the
+    shared block keeps serving other requests afterwards."""
+    mc = _model_config()
+    prompt = _shared_prefix(64)  # 8 full blocks with block_size=8
+
+    ref_eng = _engine(mc, prefix_caching=False)
+    ref_greedy = ref_eng.generate(prompt, max_new_tokens=6)
+    ref_s1 = ref_eng.generate(prompt, max_new_tokens=6,
+                              temperature=0.8, seed=1)
+    ref_s2 = ref_eng.generate(prompt, max_new_tokens=6,
+                              temperature=0.8, seed=2)
+    assert ref_s1 != ref_s2  # genuinely divergent continuations
+
+    eng = _engine(mc)
+    assert eng.generate(prompt, max_new_tokens=6) == ref_greedy  # cold
+    base_cow = eng.stats()["cow_blocks"]
+
+    # two concurrent full-hit requests diverge through COW clones of the
+    # SAME shared tail block
+    s1 = eng.submit(prompt, max_new_tokens=6, temperature=0.8, seed=1)
+    s2 = eng.submit(prompt, max_new_tokens=6, temperature=0.8, seed=2)
+    for _ in range(200):
+        if s1.done and s2.done:
+            break
+        eng.step()
+    assert list(s1) == ref_s1
+    assert list(s2) == ref_s2
+    assert eng.stats()["cow_blocks"] >= base_cow + 2
+
+    # the shared prefix survived both divergences
+    assert eng.generate(prompt, max_new_tokens=6) == ref_greedy
+    assert _pool_is_clean(eng)
+
+
+# ------------------------------------------------- (c) refcounts/leaks
+
+@pytest.mark.timeout(300)
+def test_cancel_and_release_all_with_shared_blocks(jax_cpu):
+    """Cancelling one of several requests sharing cached blocks returns
+    exactly its allocation + leftover reservation; release_all clears
+    the prefix cache too (engine create/shutdown is leak-free)."""
+    mc = _model_config()
+    prefix = _shared_prefix(32)
+    eng = _engine(mc)
+    eng.generate(prefix + [1], max_new_tokens=2)  # populate the cache
+
+    a = eng.submit(prefix + [2], max_new_tokens=30)
+    b = eng.submit(prefix + [3], max_new_tokens=30)
+    eng.step()  # prefill both (prefix mapped from cache)
+    assert eng.stats()["prefix_hit_tokens"] >= 2 * 32
+    assert not _pool_is_clean(eng)
+
+    assert eng.cancel(a.request_id) is True
+    # b still holds references to the shared blocks
+    assert eng.cache.used_blocks > 0
+    for _ in range(200):
+        if b.done:
+            break
+        eng.step()
+    assert len(list(b)) == 30
+    assert _pool_is_clean(eng), "cancel+completion must return every block"
+
+    # release_all (shutdown path) also drops the content-addressed set
+    returned = eng.cache.release_all()
+    assert returned == 0  # nothing live
+    assert len(eng.cache._free) == eng.cache.cfg.usable_blocks
+    assert eng.cache.cached_blocks == 0
+    eng.shutdown()
+    assert len(eng.cache._free) == eng.cache.cfg.usable_blocks
+
+
+# ------------------------------------------------- (d) chunked prefill
+
+@pytest.mark.timeout(300)
+def test_chunked_prefill_parity_and_decode_interleave(jax_cpu):
+    """A long prompt prefilled in 16-token chunks produces the same
+    tokens as monolithic prefill, while a running sequence keeps
+    receiving decode steps BETWEEN the chunks (step-order trace), and
+    the compile-shape count stays within the bucket bound."""
+    mc = _model_config()
+    long_prompt = [int(t) for t in
+                   np.random.default_rng(7).integers(1, 250, size=100)]
+    short_prompt = [5, 6, 7]
+
+    mono = _engine(mc)
+    mono_short = mono.generate(short_prompt, max_new_tokens=20)
+    mono_long = mono.generate(long_prompt, max_new_tokens=6)
+
+    eng = _engine(mc, prefill_chunk_tokens=16)
+    short = eng.submit(short_prompt, max_new_tokens=20)
+    eng.step()  # prefill short
+    eng.step()  # decode short
+    long = eng.submit(long_prompt, max_new_tokens=6)
+    trace = []
+    for _ in range(400):
+        if short.done and long.done:
+            break
+        if eng.step():
+            trace.append(eng.last_step_kind)
+    assert list(short) == mono_short
+    assert list(long) == mono_long
+
+    # ceil(100/16) = 7 chunks; every consecutive chunk pair must have a
+    # decode step between them while the short request was running
+    n_chunks = -(-len(long_prompt) // 16)
+    first = trace.index("prefill")
+    mid = trace[first : first + 2 * n_chunks - 1]
+    assert mid == ["prefill", "decode"] * (n_chunks - 1) + ["prefill"], (
+        f"chunked prefill must alternate with decode, got {mid}"
+    )
+    # chunk shapes reuse the existing length buckets: 3 signature kinds
+    lb = len(eng._length_buckets)
+    bb = len(eng._batch_buckets)
+    assert eng.num_compiled_shapes <= 3 * bb * lb
+    kinds = {sig[0] for sig in eng.fns.signatures}
+    assert "prefill_chunk" in kinds
+    assert _pool_is_clean(eng)
+
+
+@pytest.mark.timeout(300)
+def test_chunked_prefill_with_prefix_hits_starts_at_first_miss(jax_cpu):
+    """Chunks cover only the uncached suffix: with the prefix resident,
+    a chunked engine computes just the tail tokens."""
+    mc = _model_config()
+    prefix = _shared_prefix(64)
+    eng = _engine(mc, prefill_chunk_tokens=16)
+    cold = eng.generate(prefix + [1, 2, 3], max_new_tokens=4)
+    before = eng.stats()["prefill_tokens_total"]
+    warm = eng.generate(prefix + [1, 2, 4], max_new_tokens=4)
+    computed = eng.stats()["prefill_tokens_total"] - before
+    assert computed == 3, f"only the 3-token suffix should run, got {computed}"
+    ref = _engine(mc, prefix_caching=False)
+    assert cold == ref.generate(prefix + [1, 2, 3], max_new_tokens=4)
+    assert warm == ref.generate(prefix + [1, 2, 4], max_new_tokens=4)
+
+
+# ------------------------------------------------- (e) greedy fast path
+
+def test_sample_draws_exactly_one_uniform_on_every_path():
+    """The RNG position must be a pure function of tokens produced — on
+    the greedy/top_k==1 fast paths too — or failover resume
+    (start_index RNG fast-forward) breaks."""
+    from ray_tpu.serve.llm.engine import SamplingParams, _sample
+
+    logits = np.random.default_rng(3).normal(size=257).astype(np.float32)
+    for sp in (
+        SamplingParams(temperature=0.0),            # greedy fast path
+        SamplingParams(temperature=0.5, top_k=1),   # top-1 fast path
+        SamplingParams(temperature=0.7, top_k=4),   # full path
+        SamplingParams(temperature=1.1),            # full path, no top-k
+    ):
+        rng = np.random.default_rng(11)
+        for _ in range(5):
+            _sample(logits, sp, rng)
+        ref = np.random.default_rng(11)
+        ref.random(5)  # exactly five uniforms consumed
+        assert rng.random() == ref.random(), sp
+
+    # fast path == slow path token for top_k=1
+    greedy = _sample(logits, SamplingParams(temperature=0.0),
+                     np.random.default_rng(0))
+    top1 = _sample(logits, SamplingParams(temperature=0.9, top_k=1),
+                   np.random.default_rng(0))
+    assert greedy == top1 == int(np.argmax(logits))
+
+
+@pytest.mark.timeout(300)
+def test_resume_byte_identical_with_warm_prefix_cache(jax_cpu):
+    """Failover resume (start_index) must reproduce the remaining tokens
+    even when the resuming engine serves the prompt from its prefix
+    cache (replica that already saw the shared prefix)."""
+    mc = _model_config()
+    prefix = _shared_prefix(40)
+    prompt = prefix + [9, 8, 7]
+    kw = dict(max_new_tokens=10, temperature=0.8, seed=5)
+
+    full = _engine(mc).generate(prompt, **kw)
+    assert len(full) == 10
+
+    eng = _engine(mc)  # warm it: the prefix (and prompt) become resident
+    eng.generate(prompt, **kw)
+    k = 4
+    resumed = eng.generate(
+        prompt + full[:k],
+        max_new_tokens=10 - k,
+        temperature=0.8, seed=5, start_index=k,
+    )
+    assert resumed == full[k:]
+
+
+# ------------------------------------------- (f) admission skip-ahead
+
+@pytest.mark.timeout(300)
+def test_admission_skip_ahead_admits_small_requests_past_big_head(jax_cpu):
+    mc = _model_config()
+    # 8 usable blocks; the hog reserves 6 and decodes for a long time
+    eng = _engine(mc, num_blocks=9, max_batch_size=4, max_prefill_batch=4)
+    hog = eng.submit([1] * 5, max_new_tokens=43)     # blocks_for(48) = 6
+    eng.step()  # prefill hog
+    big = eng.submit([2] * 6, max_new_tokens=12)     # needs 3: won't fit
+    small = [eng.submit([3 + i] * 3, max_new_tokens=4) for i in range(2)]
+    eng.step()
+    st = eng.stats()
+    # the two 1-block requests were admitted PAST the stuck head
+    assert st["waiting"] == 1  # only the big head still queued
+    for _ in range(400):
+        if all(s.done for s in [hog, big] + small):
+            break
+        eng.step()
+    assert len(list(big)) == 12  # the head eventually ran too
+    assert _pool_is_clean(eng)
+
+
+@pytest.mark.timeout(300)
+def test_admission_aging_cap_stops_starving_the_head(jax_cpu):
+    mc = _model_config()
+    eng = _engine(
+        mc, num_blocks=9, max_batch_size=4, max_prefill_batch=4,
+        admission_max_skips=1,
+    )
+    hog = eng.submit([1] * 5, max_new_tokens=43)
+    eng.step()  # prefill hog (6 of 8 blocks reserved)
+    big = eng.submit([2] * 6, max_new_tokens=12)
+    s1 = eng.submit([3] * 3, max_new_tokens=4)
+    eng.step()  # s1 skips past big -> big.skips == 1 == cap
+    assert eng.stats()["waiting"] == 1
+    s2 = eng.submit([4] * 3, max_new_tokens=4)
+    eng.step()
+    # aging cap reached: s2 must NOT be admitted past the starved head
+    assert eng.stats()["waiting"] == 2
+    for _ in range(400):
+        if all(s.done for s in (hog, big, s1, s2)):
+            break
+        eng.step()
+    assert len(list(big)) == 12
+    assert _pool_is_clean(eng)
+
+
+# ------------------------------------------------- (g) LRU eviction
+
+@pytest.mark.timeout(300)
+def test_lru_eviction_when_free_list_runs_dry(jax_cpu):
+    mc = _model_config()
+    eng = _engine(mc, num_blocks=17)  # 16 usable
+    # each request parks 2 hashed prompt blocks in the LRU set on
+    # completion; after ~8 distinct prompts the free list is dry and new
+    # allocations must evict
+    for i in range(12):
+        eng.generate([i + 1] * 16, max_new_tokens=4)
+    st = eng.stats()
+    assert st["prefix_evicted_blocks"] > 0
+    assert st["kv_used_blocks"] == 0
+    assert _pool_is_clean(eng)
+    # a JUST-registered prefix is MRU -> still resident and hittable
+    before = eng.stats()["prefix_hit_tokens"]
+    eng.generate([12] * 16 + [99], max_new_tokens=4)
+    assert eng.stats()["prefix_hit_tokens"] >= before + 16
