@@ -38,10 +38,15 @@ def timed(name: str):
         record(name, time.perf_counter() - t0)
 
 
-def snapshot() -> dict[str, dict]:
+def snapshot(prefix: str | None = None) -> dict[str, dict]:
+    """Current stats; ``prefix`` restricts to one subsystem's dotted
+    namespace (e.g. ``"llm."`` for the serve/llm engine's flight-recorder
+    dump) without copying the whole table."""
     with _lock:
         out = {}
         for k, v in _stats.items():
+            if prefix is not None and not k.startswith(prefix):
+                continue
             d = dict(v)
             d["mean_ms"] = d["total_ms"] / d["count"] if d["count"] else 0.0
             out[k] = d

@@ -706,18 +706,26 @@ class CoreWorker:
             task_id=spec["task_id"], job_id=spec["job_id"], name=spec["name"],
             event="RUNNING", task_type=spec["type"],
         )
+        from ray_tpu.util.tracing import task_span
+
         failed = False
         try:
             method = getattr(self.actor_instance, spec["method_name"])
             args, kwargs = self._resolve_args(spec)
-            if spec.get("streaming"):
-                # _execute_streaming seals its own error marker, so the
-                # FINISHED/FAILED event below reports FINISHED; the consumer
-                # still sees the error through the completion marker
-                self._execute_streaming(spec, method, args, kwargs)
-            else:
-                result = method(*args, **kwargs)
-                self._store_returns(spec, result)
+            # task_span: concurrent methods run on pool threads, so each
+            # gets its own contextvar scope — a submitter's trace context
+            # propagates into streaming replica methods (serve/llm) exactly
+            # as it does on the serial path
+            with task_span(spec):
+                if spec.get("streaming"):
+                    # _execute_streaming seals its own error marker, so the
+                    # FINISHED/FAILED event below reports FINISHED; the
+                    # consumer still sees the error through the completion
+                    # marker
+                    self._execute_streaming(spec, method, args, kwargs)
+                else:
+                    result = method(*args, **kwargs)
+                    self._store_returns(spec, result)
         except Exception as e:  # noqa: BLE001 — user code may raise anything
             failed = True
             self._store_error(spec, e)

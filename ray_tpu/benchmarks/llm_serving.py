@@ -59,9 +59,10 @@ def run_serving_bench() -> dict:
     rng = np.random.default_rng(0)
     prefix = [int(t) for t in rng.integers(1, mc.vocab_size, SHARED_PREFIX_TOKENS)]
 
-    def wave(wave_idx: int) -> tuple[float, float]:
+    def wave(wave_idx: int) -> tuple[float, float, list]:
         """Run one wave of shared-prefix requests; returns wall seconds
-        spent in (prefill steps, decode steps)."""
+        spent in (prefill steps, decode steps) plus the engine-internal
+        request ids, for post-hoc timeline latency extraction."""
         streams = [
             eng.submit(
                 prefix
@@ -87,16 +88,18 @@ def run_serving_bench() -> dict:
                 decode_s += dt
         for s in streams:
             list(s)
-        return prefill_s, decode_s
+        return prefill_s, decode_s, [s.request_id for s in streams]
 
     wave(0)  # cold: compile + populate the prefix cache
     warm_prompt_tokens = 0
     warm_prefill_s = warm_decode_s = 0.0
+    warm_request_ids: list = []
     for i in range(1, WAVES):
         before = eng.stats()
-        p, d = wave(i)
+        p, d, rids = wave(i)
         warm_prefill_s += p
         warm_decode_s += d
+        warm_request_ids += rids
         after = eng.stats()
         warm_prompt_tokens += (
             after["prefix_hit_tokens"] - before["prefix_hit_tokens"]
@@ -105,6 +108,26 @@ def run_serving_bench() -> dict:
         )
     st = eng.stats()
     generated = (WAVES - 1) * WAVE_REQUESTS * MAX_NEW_TOKENS
+    # Per-request serving latencies straight off the engine's timelines
+    # (the same records engine.request_timeline() serves to operators):
+    # TTFT = submitted -> first token; TPOT = gaps between token events.
+    ttfts, tpots = [], []
+    for rid in warm_request_ids:
+        tl = eng.request_timeline(rid)
+        if tl is None:
+            continue
+        submitted = next(
+            (e["ts"] for e in tl["events"] if e["event"] == "submitted"),
+            None,
+        )
+        token_ts = [
+            e["ts"] for e in tl["events"]
+            if e["event"] in ("first_token", "token")
+        ]
+        if submitted is None or not token_ts:
+            continue
+        ttfts.append(token_ts[0] - submitted)
+        tpots.extend(np.diff(token_ts))
     eng.shutdown()
     return {
         "llm_prefix_hit_rate": round(st["prefix_hit_rate"], 4),
@@ -114,6 +137,12 @@ def run_serving_bench() -> dict:
         "llm_decode_tokens_per_sec": round(
             generated / max(warm_decode_s, 1e-9), 1
         ),
+        "llm_ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 3)
+        if ttfts else None,
+        "llm_ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 3)
+        if ttfts else None,
+        "llm_tpot_ms": round(float(np.mean(tpots)) * 1e3, 3)
+        if tpots else None,
         "prefix_hit_tokens": st["prefix_hit_tokens"],
         "prefill_tokens_computed": st["prefill_tokens_total"],
         "cow_blocks": st["cow_blocks"],

@@ -19,6 +19,7 @@ Response chunks: bytes pass through raw; any other value is JSON-encoded.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -31,6 +32,8 @@ from ray_tpu.exceptions import (
     RequestCancelledError,
     TaskError,
 )
+from ray_tpu.serve.proxy import TRACE_HEADER, TRACE_ID_HEADER, log_access
+from ray_tpu.util import tracing
 
 SERVICE_NAME = "ray_tpu.serve.ServeAPI"
 CALL_METHOD = f"/{SERVICE_NAME}/Call"
@@ -109,9 +112,10 @@ class GrpcProxy:
         md = {k: v for k, v in (context.invocation_metadata() or ())}
         return md.get("application", "default"), md.get("method", "__call__")
 
-    def _dispatch(self, request: bytes, context):
+    def _dispatch(self, request: bytes, context, state: dict | None = None):
         """-> (response, cancel) where cancel() best-effort cancels the
-        request on whichever replica serves it (None for unary calls)."""
+        request on whichever replica serves it (None for unary calls).
+        ``state`` (access-log accumulator) picks up the request id."""
         from ray_tpu.serve.handle import DeploymentHandle
 
         app_name, method = self._target(context)
@@ -129,6 +133,8 @@ class GrpcProxy:
                 payload = dict(payload)
                 payload.setdefault("request_id", uuid.uuid4().hex)
                 rid = payload["request_id"]
+                if state is not None:
+                    state["request_id"] = rid
 
                 def cancel():
                     threading.Thread(
@@ -147,55 +153,102 @@ class GrpcProxy:
 
         from ray_tpu.serve.handle import DeploymentResponseGenerator
 
+        md = {k: v for k, v in (context.invocation_metadata() or ())}
+        state: dict = {"t0": time.perf_counter()}
+        # gRPC handlers run on their own worker thread, so the root span
+        # opens inline (cf. the HTTP proxy, which must open it on the
+        # executor thread); opt-in via the TRACE_HEADER metadata key
+        root = (
+            tracing.span("grpc.request", rpc="Call",
+                         method=md.get("method", "__call__"))
+            if TRACE_HEADER in md else contextlib.nullcontext({})
+        )
         try:
-            response, _cancel = self._dispatch(request, context)
-            if isinstance(response, DeploymentResponseGenerator):
-                # unary call on a streaming method: drain into a list.
-                # Deliberate but surprising — tell the client (the Stream
-                # rpc is the intended entry; reference proxies reject this)
-                import logging
+            with root as ctx:
+                if ctx.get("trace_id"):
+                    state["trace_id"] = ctx["trace_id"]
+                    context.send_initial_metadata(
+                        ((TRACE_ID_HEADER, ctx["trace_id"]),))
+                response, _cancel = self._dispatch(request, context, state)
+                if isinstance(response, DeploymentResponseGenerator):
+                    # unary call on a streaming method: drain into a list.
+                    # Deliberate but surprising — tell the client (the
+                    # Stream rpc is the intended entry; reference proxies
+                    # reject this)
+                    import logging
 
-                logging.getLogger("ray_tpu.serve").warning(
-                    "unary Call on a streaming deployment method — "
-                    "draining the full stream into one response; use the "
-                    "Stream rpc for incremental chunks")
-                context.set_trailing_metadata(
-                    (("ray-tpu-streaming-drained", "true"),))
-                # the drain respects the TOTAL request budget, not just
-                # per-chunk gaps — else a slow long generator pins one of
-                # the fixed worker threads indefinitely
-                budget = self.options.request_timeout_s
-                deadline = (time.monotonic() + budget
-                            if budget is not None else None)
-                chunks = []
-                for chunk in response:
-                    chunks.append(chunk)
-                    if deadline is not None and time.monotonic() > deadline:
-                        context.abort(
-                            grpc.StatusCode.DEADLINE_EXCEEDED,
-                            f"streaming drain exceeded request_timeout_s="
-                            f"{budget}; use the Stream rpc")
-                return _encode(chunks)
-            return _encode(
-                response.result(timeout=self.options.request_timeout_s))
+                    logging.getLogger("ray_tpu.serve").warning(
+                        "unary Call on a streaming deployment method — "
+                        "draining the full stream into one response; use "
+                        "the Stream rpc for incremental chunks")
+                    context.set_trailing_metadata(
+                        (("ray-tpu-streaming-drained", "true"),))
+                    # the drain respects the TOTAL request budget, not just
+                    # per-chunk gaps — else a slow long generator pins one
+                    # of the fixed worker threads indefinitely
+                    budget = self.options.request_timeout_s
+                    deadline = (time.monotonic() + budget
+                                if budget is not None else None)
+                    chunks = []
+                    for chunk in response:
+                        chunks.append(chunk)
+                        if deadline is not None and time.monotonic() > deadline:
+                            context.abort(
+                                grpc.StatusCode.DEADLINE_EXCEEDED,
+                                f"streaming drain exceeded request_timeout_s="
+                                f"{budget}; use the Stream rpc")
+                    state["tokens"] = len(chunks)
+                    log_access("grpc", CALL_METHOD, state, status="OK")
+                    return _encode(chunks)
+                out = response.result(
+                    timeout=self.options.request_timeout_s)
+                log_access("grpc", CALL_METHOD, state, status="OK")
+                return _encode(out)
         except KeyError as e:
+            log_access("grpc", CALL_METHOD, state,
+                       status="NOT_FOUND", error=str(e))
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except Exception as e:  # noqa: BLE001 — surface to the client
-            context.abort(_code_for(e), str(e))
+            code = _code_for(e)
+            log_access("grpc", CALL_METHOD, state,
+                       status=code.name, error=str(e))
+            context.abort(code, str(e))
 
     def _stream(self, request: bytes, context):
         import grpc
 
         from ray_tpu.serve.handle import DeploymentResponseGenerator
 
+        md = {k: v for k, v in (context.invocation_metadata() or ())}
+        state: dict = {"t0": time.perf_counter()}
         try:
-            response, cancel = self._dispatch(request, context)
+            # span covers the dispatch only — the .remote() below captures
+            # trace_ctx into the task spec; chunk pulls need no context
+            root = (
+                tracing.span("grpc.request", rpc="Stream",
+                             method=md.get("method", "__call__"))
+                if TRACE_HEADER in md else contextlib.nullcontext({})
+            )
+            with root as ctx:
+                if ctx.get("trace_id"):
+                    state["trace_id"] = ctx["trace_id"]
+                response, cancel = self._dispatch(request, context, state)
         except KeyError as e:
+            log_access("grpc", STREAM_METHOD, state,
+                       status="NOT_FOUND", error=str(e))
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             return
         except Exception as e:  # noqa: BLE001
-            context.abort(_code_for(e), str(e))
+            code = _code_for(e)
+            log_access("grpc", STREAM_METHOD, state,
+                       status=code.name, error=str(e))
+            context.abort(code, str(e))
             return
+        if "trace_id" in state:
+            # echo the assigned trace id before the first chunk, mirroring
+            # the HTTP proxy's response header
+            context.send_initial_metadata(
+                ((TRACE_ID_HEADER, state["trace_id"]),))
         finished = threading.Event()
         if cancel is not None:
             # fires when the RPC terminates for ANY reason; only a client
@@ -206,14 +259,22 @@ class GrpcProxy:
         try:
             if isinstance(response, DeploymentResponseGenerator):
                 for chunk in response:
+                    if "ttft_ms" not in state:
+                        state["ttft_ms"] = round(
+                            (time.perf_counter() - state["t0"]) * 1000.0, 3)
+                    state["tokens"] = state.get("tokens", 0) + 1
                     yield _encode(chunk)
             else:
                 yield _encode(
                     response.result(timeout=self.options.request_timeout_s))
             finished.set()
+            log_access("grpc", STREAM_METHOD, state, status="OK")
         except Exception as e:  # noqa: BLE001
             finished.set()
-            context.abort(_code_for(e), str(e))
+            code = _code_for(e)
+            log_access("grpc", STREAM_METHOD, state,
+                       status=code.name, error=str(e))
+            context.abort(code, str(e))
 
     # -- server lifecycle --
 

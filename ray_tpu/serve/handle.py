@@ -20,6 +20,7 @@ from typing import Any
 
 import ray_tpu
 from ray_tpu._private import chaos
+from ray_tpu.util import tracing
 
 _TABLE_REFRESH_S = 0.25
 
@@ -344,12 +345,21 @@ class _Router:
             is_stream = method_name in self._stream_methods
         replica = self._pick_replica(time.monotonic() + 30, exclude)
         aid = replica._actor_id.binary()
+        # when the caller carries a trace, open a dispatch span so the
+        # replica task (whose trace_ctx is captured at .remote() time)
+        # parents under it; no-op for untraced callers
+        dispatch_span = tracing.span_if_active(
+            "handle.dispatch",
+            deployment=f"{self.app_name}/{self.deployment_name}",
+            method=method_name,
+        )
         if is_stream:
             # generator replica method: dispatch through the streaming
             # call path so chunks seal (and are fetchable) as produced
-            gen = replica.rt_call_stream.options(
-                num_returns="streaming"
-            ).remote(method_name, args, kwargs)
+            with dispatch_span:
+                gen = replica.rt_call_stream.options(
+                    num_returns="streaming"
+                ).remote(method_name, args, kwargs)
             oid = gen.completed_ref.object_id.binary()
             with self._lock:
                 self._inflight[aid] = self._inflight.get(aid, 0) + 1
@@ -359,7 +369,8 @@ class _Router:
                 chunk_timeout_s=options.get("stream_chunk_timeout_s", 120.0))
             out.replica_actor_id = aid
             return out
-        ref = replica.rt_call.remote(method_name, args, kwargs)
+        with dispatch_span:
+            ref = replica.rt_call.remote(method_name, args, kwargs)
         oid = ref.object_id.binary()
         with self._lock:
             self._inflight[aid] = self._inflight.get(aid, 0) + 1

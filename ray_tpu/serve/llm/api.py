@@ -31,12 +31,17 @@ resumed stream byte-identical to an uninterrupted one.
 from __future__ import annotations
 
 import uuid
+from collections import OrderedDict
 from typing import Any
 
 from ray_tpu._private import chaos
 from ray_tpu.serve.deployment import Application, deployment
 from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, SamplingParams
-from ray_tpu.util import metrics
+from ray_tpu.util import metrics, tracing
+
+# external request ids whose engine-internal id we remember after the
+# stream finished, so request_timeline() works post-hoc
+_RECENT_REQUESTS = 512
 
 
 def encode_text(prompt: str, vocab_size: int) -> list[int]:
@@ -61,6 +66,9 @@ class LLMDeployment:
         self.engine = LLMEngine(engine_config)
         # external request_id -> engine-internal id, for cancel()
         self._active: dict[str, Any] = {}
+        # same mapping, kept (bounded) after completion for
+        # request_timeline() lookups on finished streams
+        self._recent: OrderedDict[str, Any] = OrderedDict()
         self._resumed_total = 0
         self._m_resumed = metrics.counter(
             "llm_requests_resumed",
@@ -98,17 +106,31 @@ class LLMDeployment:
             deadline_s=float(deadline_s) if deadline_s is not None else None,
             start_index=len(prior),
         )
-        stream = self.engine.submit(prompt + prior, sampling)
+        # the replica method runs inside a task_span when the caller was
+        # traced — hand that context to the engine so its phase spans join
+        # the same trace, and stamp the trace id on every chunk so a
+        # resumed stream can assert trace continuity across replicas
+        trace_ctx = tracing.current_context()
+        trace_id = trace_ctx["trace_id"] if trace_ctx else None
+        stream = self.engine.submit(
+            prompt + prior, sampling, trace_ctx=trace_ctx
+        )
         self._active[request_id] = stream.request_id
+        self._recent[request_id] = stream.request_id
+        while len(self._recent) > _RECENT_REQUESTS:
+            self._recent.popitem(last=False)
         try:
             for i, tok in enumerate(stream):
                 index = len(prior) + i
-                yield {
+                chunk = {
                     "request_id": request_id,
                     "token": int(tok),
                     "index": index,
                     "text": decode_token(tok),
                 }
+                if trace_id is not None:
+                    chunk["trace_id"] = trace_id
+                yield chunk
                 chaos.fire(
                     "llm.token",
                     index=index,
@@ -135,6 +157,24 @@ class LLMDeployment:
     def stats(self) -> dict:
         """Engine introspection (unary method — callable via handle)."""
         out = self.engine.stats()
+        out["requests_resumed"] = self._resumed_total
+        return out
+
+    def request_timeline(self, request_id: str) -> dict | None:
+        """Phase timeline of one EXTERNAL request id — live or recently
+        finished on this replica; None if this replica never served it
+        (broadcast to find the owner, like cancel)."""
+        internal = self._active.get(str(request_id))
+        if internal is None:
+            internal = self._recent.get(str(request_id))
+        if internal is None:
+            return None
+        return self.engine.request_timeline(internal)
+
+    def debug_dump(self) -> dict:
+        """Flight-recorder ring + engine/cache stats (the payload behind
+        the proxy's /debug/llm endpoint)."""
+        out = self.engine.debug_dump()
         out["requests_resumed"] = self._resumed_total
         return out
 

@@ -73,6 +73,17 @@ class DecodeFns:
         self.model_cfg = model_cfg
         self.init, self._prefill, self._decode = _jitted(family, model_cfg)
         self._signatures: set[tuple] = set()
+        # called with (kind, tokens_shape, tables_shape) the first time
+        # THIS instance sees a signature — the engine hangs its
+        # compile-event counter here (jitted programs are process-shared,
+        # so per-instance first-use is the per-engine compile event)
+        self.on_new_signature = None
+
+    def _note(self, sig: tuple) -> None:
+        if sig not in self._signatures:
+            self._signatures.add(sig)
+            if self.on_new_signature is not None:
+                self.on_new_signature(sig)
 
     def prefill(
         self, params, cache_k, cache_v, tokens, lengths, block_tables,
@@ -84,7 +95,7 @@ class DecodeFns:
         # attention over already-resident context). The two trace to
         # different programs, so they get distinct signature kinds.
         kind = "prefill" if start is None else "prefill_chunk"
-        self._signatures.add(
+        self._note(
             (kind, tuple(tokens.shape), tuple(block_tables.shape))
         )
         if start is None:
@@ -97,7 +108,7 @@ class DecodeFns:
         )
 
     def decode(self, params, cache_k, cache_v, tokens, positions, block_tables):
-        self._signatures.add(
+        self._note(
             ("decode", tuple(tokens.shape), tuple(block_tables.shape))
         )
         return self._decode(

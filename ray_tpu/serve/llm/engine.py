@@ -63,16 +63,17 @@ Failure semantics (docs/SERVING_LLM.md "Failure semantics"):
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Any, Sequence
 
 import numpy as np
 
-from ray_tpu._private import chaos
+from ray_tpu._private import chaos, event_stats
 from ray_tpu.exceptions import (
     DeadlineExceededError,
     EngineDiedError,
@@ -80,9 +81,12 @@ from ray_tpu.exceptions import (
     RequestCancelledError,
 )
 from ray_tpu.serve._shapes import pad_to_bucket, pow2_buckets
+from ray_tpu.serve.llm import obs
 from ray_tpu.serve.llm.decode import DecodeFns
 from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
-from ray_tpu.util import metrics
+from ray_tpu.util import metrics, tracing
+
+logger = logging.getLogger("ray_tpu.serve.llm")
 
 _DONE = object()  # stream sentinel
 
@@ -125,6 +129,13 @@ class EngineConfig:
     prefill_chunk_tokens: int | None = None
     admission_probe: int = 4      # skip-ahead width when the head won't fit
     admission_max_skips: int = 16  # aging cap: stop skipping a starved head
+    # Flight recorder: ring of the last N step records, dumped as JSON on
+    # EngineDiedError / watchdog timeout / shutdown(dump=...). Dir: None
+    # -> $RAY_TPU_FLIGHT_DIR -> <tmp>/ray_tpu_flight (obs.dump_dir).
+    flight_recorder_steps: int = 256
+    flight_recorder_dir: str | None = None
+    # Finished-request timelines kept for request_timeline() lookups.
+    timeline_history: int = 256
 
 
 class TokenStream:
@@ -157,12 +168,25 @@ class _Request:
         "id", "prompt", "sampling", "out", "generated", "rng",
         "reserved_blocks", "drawn_blocks", "prefill_done", "cached_tokens",
         "started", "skips", "table_np", "table_key", "done", "deadline",
+        # lifecycle observability (ISSUE 4): the phase timeline rides the
+        # request, and a stored trace context turns it into spans on finish
+        "trace_ctx", "timeline", "submitted_clock", "first_token_clock",
+        "last_token_clock", "finish_reason",
     )
 
-    def __init__(self, req_id, prompt, sampling: SamplingParams):
+    def __init__(self, req_id, prompt, sampling: SamplingParams,
+                 trace_ctx: dict | None = None):
         self.id = req_id
         self.prompt = list(prompt)
         self.sampling = sampling
+        self.trace_ctx = trace_ctx
+        # [{"event", "ts"(wall), ...}] — submitted/admitted/prefill chunks/
+        # first_token/token/terminal; bounded by the request's own lifetime
+        self.timeline: list[dict] = []
+        self.submitted_clock: float | None = None
+        self.first_token_clock: float | None = None
+        self.last_token_clock: float | None = None
+        self.finish_reason: str | None = None
         self.out: queue.Queue = queue.Queue()
         self.generated: list[int] = []
         self.rng = np.random.default_rng(sampling.seed)
@@ -322,6 +346,17 @@ class LLMEngine:
         self.last_step_kind: str | None = None
         # last cache-stat values already exported to the monotonic counters
         self._exported = {"hit": 0, "evict": 0, "cow": 0, "prefill": 0}
+        # ---- observability plane (ISSUE 4) ----
+        self._flight = obs.FlightRecorder(cfg.flight_recorder_steps)
+        # finished-request timelines, newest-last, bounded
+        self._timelines: OrderedDict[Any, dict] = OrderedDict()
+        # per-step admission/expiry counts for the flight record (set by
+        # step(), read by the phase that runs in the same iteration)
+        self._step_admitted = 0
+        self._step_expired = 0
+        # cache-stat values as of the previous flight record (deltas)
+        self._flight_prev = {"cow": 0, "evict": 0}
+        self._dumped = False  # one post-mortem dump per engine
 
         self._m_tokens = metrics.counter(
             "llm_engine_tokens_generated",
@@ -368,6 +403,13 @@ class LLMEngine:
             "llm_prefill_tokens",
             "Prompt tokens actually computed by prefill (cache misses)",
         )
+        self._m_ttft = obs.ttft_histogram()
+        self._m_tpot = obs.tpot_histogram()
+        self._m_queue_wait = obs.queue_wait_histogram()
+        self._m_compile = obs.compile_counter()
+        # count compile events by shape key as DecodeFns sees new
+        # signatures (attribute hook — DecodeFns stays constructible bare)
+        self.fns.on_new_signature = self._on_new_signature
 
     # ---------------- public API ----------------
 
@@ -375,14 +417,25 @@ class LLMEngine:
         self,
         prompt: Sequence[int],
         sampling: SamplingParams | None = None,
+        *,
+        trace_ctx: dict | None = None,
         **sampling_overrides,
     ) -> TokenStream:
         """Enqueue one request; returns a stream of generated token ids.
+
+        ``trace_ctx`` carries the caller's trace context
+        (``tracing.current_context()`` shape) across the thread boundary
+        into the scheduler; when absent, the submitting thread's active
+        span is captured. With a context, the request's phase timeline is
+        emitted as ``engine.*`` spans on completion — one trace covers
+        HTTP -> router -> replica -> engine.
 
         Raises ``EngineOverloadedError`` when admission control rejects
         (waiting queue full, or queued worst-case blocks over budget) and
         ``EngineDiedError`` when the engine has already failed.
         """
+        if trace_ctx is None:
+            trace_ctx = tracing.current_context()
         if sampling is None:
             sampling = SamplingParams(**sampling_overrides)
         elif sampling_overrides:
@@ -421,8 +474,11 @@ class LLMEngine:
                     f"{self._waiting_blocks} worst-case blocks queued); "
                     "retry later"
                 )
-            req = _Request(self._next_id, prompt, sampling)
+            req = _Request(self._next_id, prompt, sampling, trace_ctx)
             self._next_id += 1
+            req.submitted_clock = obs.clock()
+            self._tl(req, "submitted", prompt_tokens=len(prompt),
+                     max_new_tokens=sampling.max_new_tokens)
             self._waiting.append(req)
             self._waiting_blocks += need
             self._m_queue.set(len(self._waiting))
@@ -453,11 +509,11 @@ class LLMEngine:
         never starves running sequences of decode steps. Returns False
         when idle."""
         with self._lock:
-            self._step_begin = time.perf_counter()
+            self._step_begin = obs.clock()
             try:
                 chaos.fire("engine.step")
-                self._expire_deadlines_locked()
-                self._admit_locked()
+                self._step_expired = self._expire_deadlines_locked()
+                self._step_admitted = self._admit_locked()
                 # Fresh admissions prefill immediately (first token out the
                 # door); CONTINUING chunks of a long prompt alternate with
                 # decode so running sequences are never starved.
@@ -489,6 +545,7 @@ class LLMEngine:
             self._evict_locked(req)
             self._cancelled_total += 1
             self._m_cancelled.inc()
+            self._finish_obs_locked(req, "cancelled")
             req.out.put(
                 RequestCancelledError(f"request {request_id!r} cancelled")
             )
@@ -529,19 +586,54 @@ class LLMEngine:
     def failed(self) -> bool:
         return self._failed is not None
 
-    def shutdown(self) -> None:
+    def request_timeline(self, request_id) -> dict | None:
+        """Phase timeline of one request (live or recently finished):
+        ``{"request_id", "trace_id", "finish_reason", "events": [...]}``
+        where each event is ``{"event", "ts"(wall seconds), ...}`` for
+        submitted / admitted / prefill[_chunk] / first_token / token /
+        terminal. Finished timelines are kept for the last
+        ``timeline_history`` requests; returns None for unknown ids."""
+        with self._lock:
+            r = self._find_locked(request_id)
+            if r is not None:
+                return self._timeline_dict(r)
+            return self._timelines.get(request_id)
+
+    def debug_dump(self) -> dict:
+        """One-call post-mortem/state dump: flight-recorder ring, engine
+        stats, cache snapshot, compiled shapes, and the process's
+        event_stats. Exposed replica-side as ``LLMDeployment.debug_dump``
+        and proxy-side as ``GET /debug/llm``."""
+        with self._lock:
+            return self._flight.dump("debug", extra={
+                "stats": self.stats(),
+                "cache": self.cache.debug_snapshot(),
+                "compiled_shapes": sorted(
+                    obs.shape_key(s) for s in self.fns.signatures
+                ),
+                "archived_timelines": len(self._timelines),
+            })
+
+    def shutdown(self, dump: bool | str | None = None) -> None:
         """Stop stepping, fail every pending stream with a clear error,
         and return ALL KV blocks (allocations, reservations, and the
         prefix cache) to the pool — repeated create/shutdown in one
-        process is leak-free."""
+        process is leak-free.
+
+        ``dump=True`` writes a flight-recorder JSON dump to the configured
+        dump dir on the way out; a string is an explicit file path."""
         with self._lock:
             if self._stopped:
                 return
+            if dump:
+                self._dump("shutdown",
+                           path=dump if isinstance(dump, str) else None)
             self._stopped = True
             err = RequestCancelledError("engine shut down")
             for r in list(self._waiting) + self._prefilling + self._running:
                 if not r.done:
                     r.done = True
+                    self._finish_obs_locked(r, "shutdown")
                     r.out.put(err)
                     r.out.put(_DONE)
             self.cache.release_all()
@@ -599,8 +691,9 @@ class LLMEngine:
         self._m_util.set(self.cache.utilization)
         self._work.notify_all()  # freed blocks may unblock admissions
 
-    def _expire_deadlines_locked(self) -> None:
+    def _expire_deadlines_locked(self) -> int:
         now = time.monotonic()
+        expired = 0
         for r in [
             r
             for r in list(self._waiting) + self._prefilling + self._running
@@ -609,6 +702,8 @@ class LLMEngine:
             self._evict_locked(r)
             self._deadline_total += 1
             self._m_deadline.inc()
+            expired += 1
+            self._finish_obs_locked(r, "expired")
             r.out.put(
                 DeadlineExceededError(
                     f"request {r.id!r} deadline "
@@ -617,6 +712,7 @@ class LLMEngine:
                 )
             )
             r.out.put(_DONE)
+        return expired
 
     def _try_admit_one_locked(self, req: _Request) -> bool:
         """Reserve worst-case blocks for one request, allocate its table,
@@ -667,15 +763,16 @@ class LLMEngine:
             req.cached_tokens = req.prefill_done
         return True
 
-    def _admit_locked(self) -> None:
+    def _admit_locked(self) -> int:
         """Move waiting requests into the prefilling set. FIFO first; when
         the head's reservation doesn't fit, probe up to
         ``admission_probe`` requests behind it — unless the head has
         already been skipped ``admission_max_skips`` times, in which case
-        admission stalls until the head fits (no starvation)."""
+        admission stalls until the head fits (no starvation). Returns the
+        number admitted this step."""
         admitted = 0
         if not self._waiting:
-            return
+            return 0
         head = self._waiting[0]
         probe_budget = (
             self.cfg.admission_probe
@@ -698,6 +795,12 @@ class LLMEngine:
                 )
                 self._prefilling.append(req)
                 admitted += 1
+                self._m_queue_wait.observe(
+                    obs.clock() - req.submitted_clock
+                )
+                self._tl(req, "admitted",
+                         cached_tokens=req.cached_tokens,
+                         reserved_blocks=req.reserved_blocks)
             else:
                 if probed >= probe_budget:
                     break
@@ -707,6 +810,7 @@ class LLMEngine:
             if head in self._waiting:
                 head.skips += 1  # someone was admitted past the head
             self._m_queue.set(len(self._waiting))
+        return admitted
 
     def _table_for(self, r: _Request, nb: int) -> np.ndarray:
         """Host block table for one request, rebuilt only when a block was
@@ -749,7 +853,8 @@ class LLMEngine:
 
         batch = self._prefilling[: self.cfg.max_prefill_batch]
         chaos.fire("engine.prefill", batch=len(batch))
-        t0 = time.perf_counter()
+        t0 = obs.clock()
+        t0_wall = obs.wall()
         bs = self.cfg.block_size
         cap = self.cfg.prefill_chunk_tokens
         ns = []
@@ -797,9 +902,17 @@ class LLMEngine:
             start=None if legacy else jnp.asarray(starts),
         )
         host = _host_logits(logits)
+        # dt covers the phase's real cost — COW copies, padding, the
+        # jitted call and THE host sync. The same value feeds the latency
+        # histogram, the flight record, event_stats, and the per-request
+        # chunk timeline entries, so every record agrees (one clock).
+        dt = obs.clock() - t0
+        kind = "prefill" if legacy else "prefill_chunk"
         for i, (r, n) in enumerate(zip(batch, ns)):
             r.prefill_done += n
             self._prefill_tokens_total += n
+            self._tl(r, kind, ts=t0_wall, dur_ms=round(dt * 1000.0, 3),
+                     tokens=n, prefill_done=r.prefill_done)
             if self.cfg.prefix_caching:
                 self.cache.register_prefix(r.id, r.prompt, r.prefill_done)
             if r.prefill_done >= len(r.prompt):
@@ -811,15 +924,19 @@ class LLMEngine:
                     self._running.append(r)
         self._m_util.set(self.cache.utilization)
         self._sync_cache_counters_locked()
-        self._m_latency.observe(
-            time.perf_counter() - t0, tags={"kind": "prefill"}
+        self._m_latency.observe(dt, tags={"kind": kind})
+        event_stats.record(f"llm.engine.step.{kind}", dt)
+        self._flight_record_locked(
+            kind, t0_wall, dt, batch=len(batch), bucket_b=B, bucket_len=S,
+            nb=nb, tokens=int(sum(ns)),
         )
 
     def _decode_locked(self) -> None:
         import jax.numpy as jnp
 
         chaos.fire("engine.decode", batch=len(self._running))
-        t0 = time.perf_counter()
+        t0 = obs.clock()
+        t0_wall = obs.wall()
         bs = self.cfg.block_size
         batch = list(self._running)
         pairs: list[tuple[int, int]] = []
@@ -847,18 +964,33 @@ class LLMEngine:
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
         )
         host = _host_logits(logits)
+        dt = obs.clock() - t0
         for i, r in enumerate(batch):
             self._emit_locked(r, host[i])
         self._running = [r for r in self._running if not r.done]
         self._m_util.set(self.cache.utilization)
         self._sync_cache_counters_locked()
-        self._m_latency.observe(
-            time.perf_counter() - t0, tags={"kind": "decode"}
+        self._m_latency.observe(dt, tags={"kind": "decode"})
+        event_stats.record("llm.engine.step.decode", dt)
+        self._flight_record_locked(
+            "decode", t0_wall, dt, batch=len(batch), bucket_b=B,
+            bucket_len=ctx, nb=nb, tokens=len(batch),
         )
 
     def _emit_locked(self, r: _Request, logits_row: np.ndarray) -> None:
         tok = _sample(logits_row, r.sampling, r.rng)
         r.generated.append(tok)
+        now = obs.clock()
+        if r.first_token_clock is None:
+            r.first_token_clock = now
+            self._m_ttft.observe(now - r.submitted_clock)
+            self._tl(r, "first_token",
+                     index=r.sampling.start_index + len(r.generated) - 1)
+        else:
+            self._m_tpot.observe(now - r.last_token_clock)
+            self._tl(r, "token",
+                     index=r.sampling.start_index + len(r.generated) - 1)
+        r.last_token_clock = now
         r.out.put(tok)
         self._m_tokens.inc()
         if (
@@ -873,6 +1005,7 @@ class LLMEngine:
         if leftover > 0:
             self.cache.release_reservation(leftover)
         r.done = True
+        self._finish_obs_locked(r, "finished")
         r.out.put(_DONE)
         self._work.notify_all()  # freed blocks may unblock admissions
 
@@ -891,6 +1024,156 @@ class LLMEngine:
                 counter.inc(delta)
                 self._exported[key] = value
 
+    # ---------------- observability (ISSUE 4) ----------------
+
+    def _tl(self, r: _Request, event: str, ts: float | None = None,
+            **attrs) -> None:
+        """Append one phase event to a request's timeline (host list
+        append — always on; the expensive part, span emission, only
+        happens for traced requests at finish)."""
+        e = {"event": event, "ts": obs.wall() if ts is None else ts}
+        if attrs:
+            e.update(attrs)
+        r.timeline.append(e)
+
+    def _timeline_dict(self, r: _Request) -> dict:
+        return {
+            "request_id": r.id,
+            "trace_id": r.trace_ctx["trace_id"] if r.trace_ctx else None,
+            "finish_reason": r.finish_reason,
+            "events": list(r.timeline),
+        }
+
+    def _finish_obs_locked(self, r: _Request, reason: str) -> None:
+        """Terminal bookkeeping for one request: stamp the terminal
+        timeline event, archive the timeline for request_timeline(), and
+        — when the submitter carried a trace context — emit the whole
+        lifecycle as engine.* spans. Idempotent (failover/cancel races)."""
+        if r.finish_reason is not None:
+            return
+        r.finish_reason = reason
+        self._tl(r, reason, tokens=len(r.generated))
+        self._timelines[r.id] = self._timeline_dict(r)
+        while len(self._timelines) > self.cfg.timeline_history:
+            self._timelines.popitem(last=False)
+        if r.trace_ctx:
+            try:
+                self._emit_spans(r)
+            except Exception as e:  # noqa: BLE001 — spans are best-effort
+                logger.warning("span emission failed for %r: %r", r.id, e)
+
+    def _emit_spans(self, r: _Request) -> None:
+        """Turn a finished request's timeline into spans on the tracing
+        plane: one ``engine.request`` parent under the submitter's span,
+        with ``engine.queued``, per-chunk ``engine.prefill[_chunk]``, a
+        zero-length ``engine.first_token`` marker, and one aggregate
+        ``engine.decode`` child."""
+        tid = r.trace_ctx["trace_id"]
+        events = r.timeline
+        start = events[0]["ts"]
+        end = events[-1]["ts"]
+        root = tracing.record_span(
+            "engine.request", trace_id=tid,
+            parent_span_id=r.trace_ctx.get("parent_span_id"),
+            start=start, end=end, kind="engine",
+            attrs={
+                "request_id": str(r.id),
+                "finish_reason": r.finish_reason,
+                "prompt_tokens": len(r.prompt),
+                "cached_tokens": r.cached_tokens,
+                "tokens": len(r.generated),
+            },
+        )
+        first_ts = last_ts = None
+        decode_tokens = 0
+        for e in events:
+            ev = e["event"]
+            if ev == "admitted":
+                tracing.record_span(
+                    "engine.queued", trace_id=tid, parent_span_id=root,
+                    start=start, end=e["ts"], kind="engine", attrs={},
+                )
+            elif ev in ("prefill", "prefill_chunk"):
+                tracing.record_span(
+                    f"engine.{ev}", trace_id=tid, parent_span_id=root,
+                    start=e["ts"],
+                    end=e["ts"] + e.get("dur_ms", 0.0) / 1000.0,
+                    kind="engine",
+                    attrs={"tokens": e.get("tokens"),
+                           "prefill_done": e.get("prefill_done")},
+                )
+            elif ev == "first_token":
+                first_ts = last_ts = e["ts"]
+                tracing.record_span(
+                    "engine.first_token", trace_id=tid,
+                    parent_span_id=root, start=e["ts"], end=e["ts"],
+                    kind="marker", attrs={"index": e.get("index")},
+                )
+            elif ev == "token":
+                last_ts = e["ts"]
+                decode_tokens += 1
+        if first_ts is not None and last_ts > first_ts:
+            tracing.record_span(
+                "engine.decode", trace_id=tid, parent_span_id=root,
+                start=first_ts, end=last_ts, kind="engine",
+                attrs={"tokens": decode_tokens},
+            )
+
+    def _flight_record_locked(self, kind: str, t_wall: float, dt: float,
+                              **fields) -> None:
+        """One ring-buffer record per work step. O(1): a handful of int
+        reads and one bounded deque append — no device access."""
+        cs = self.cache.stats
+        rec = {
+            "kind": kind,
+            "ts": round(t_wall, 6),
+            "dur_ms": round(dt * 1000.0, 3),
+            "admitted": self._step_admitted,
+            "expired": self._step_expired,
+            "cow": cs.cow_copies - self._flight_prev["cow"],
+            "evicted_blocks": (
+                cs.prefix_evicted_blocks - self._flight_prev["evict"]
+            ),
+            "kv_util": round(self.cache.utilization, 4),
+            "waiting": len(self._waiting),
+            "prefilling": len(self._prefilling),
+            "running": len(self._running),
+        }
+        rec.update(fields)
+        self._flight_prev["cow"] = cs.cow_copies
+        self._flight_prev["evict"] = cs.prefix_evicted_blocks
+        self._flight.record(rec)
+
+    def _on_new_signature(self, sig: tuple) -> None:
+        """DecodeFns hook: a shape this engine has not run before — i.e.
+        a compile event (programs are process-shared; this counts first
+        use per engine). Tagged by shape key; also marked in the flight
+        ring so a latency spike next to a compile explains itself."""
+        key = obs.shape_key(sig)
+        self._m_compile.inc(tags={"shape": key})
+        self._flight.record(
+            {"kind": "compile", "ts": obs.wall(), "shape": key}
+        )
+
+    def _dump(self, reason: str, *, path: str | None = None,
+              lock_free: bool = False) -> str | None:
+        """Write the flight recorder to disk. ``lock_free=True`` is the
+        watchdog path: the wedged stepper may hold the lock, so only
+        lock-free state goes in (the ring snapshot is GIL-atomic)."""
+        extra: dict = {}
+        if not lock_free:
+            extra["stats"] = self.stats()
+            extra["cache"] = self.cache.debug_snapshot()
+        out = obs.write_dump(
+            self._flight.dump(reason, extra=extra),
+            dir=self.cfg.flight_recorder_dir, path=path,
+        )
+        if out is not None:
+            logger.warning(
+                "llm engine flight recorder (%s) dumped to %s", reason, out
+            )
+        return out
+
     # ---------------- failure handling ----------------
 
     def _fail_engine(self, e: BaseException) -> None:
@@ -904,12 +1187,31 @@ class LLMEngine:
             err.__cause__ = e
         with self._lock:
             self._failed = err
+            if not self._dumped:
+                self._dumped = True
+                self._dump("engine_died")
             self._fan_out_failure(err)
+        # the controller will replace this replica as soon as
+        # check_health() runs — push the post-mortem spans out NOW or
+        # they die in the task-event buffer with the worker
+        self._flush_task_events()
+
+    @staticmethod
+    def _flush_task_events() -> None:
+        from ray_tpu._private.worker import global_worker_or_none
+
+        try:
+            w = global_worker_or_none()
+            if w is not None and getattr(w, "task_events", None) is not None:
+                w.task_events.flush()
+        except Exception as e:  # noqa: BLE001 — never fail the failure path
+            logger.warning("task-event flush on engine failure: %r", e)
 
     def _fan_out_failure(self, err: EngineDiedError) -> None:
         for r in list(self._waiting) + self._prefilling + self._running:
             if not r.done:
                 r.done = True
+                self._finish_obs_locked(r, "failed")
                 r.out.put(err)
                 r.out.put(_DONE)
         self._waiting.clear()
@@ -970,12 +1272,17 @@ class LLMEngine:
         poll = max(0.005, min(0.05, timeout / 10.0))
         while not self._stopped and self._failed is None:
             begin = self._step_begin
-            if begin is not None and time.perf_counter() - begin > timeout:
+            if begin is not None and obs.clock() - begin > timeout:
                 err = EngineDiedError(
                     f"engine step wedged for > {timeout}s; "
                     "failing all in-flight streams"
                 )
                 self._failed = err
+                if not self._dumped:
+                    # lock-free by design (the wedged stepper may hold the
+                    # lock): ring snapshot only, no stats()
+                    self._dumped = True
+                    self._dump("watchdog_timeout", lock_free=True)
                 for r in (
                     list(self._waiting) + self._prefilling + self._running
                 ):
@@ -983,5 +1290,6 @@ class LLMEngine:
                         r.done = True
                         r.out.put(err)
                         r.out.put(_DONE)
+                self._flush_task_events()
                 return
             time.sleep(poll)

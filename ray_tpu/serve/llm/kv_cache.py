@@ -380,5 +380,28 @@ class PagedKVCache:
         change — cache key for host-side materialized block tables."""
         return self._versions[seq_id]
 
+    def debug_snapshot(self) -> dict:
+        """JSON-safe accounting snapshot for the engine's flight-recorder
+        / debug dumps — block-pool state plus the cumulative CacheStats
+        counters, no device arrays."""
+        s = self.stats
+        return {
+            "num_blocks": self.cfg.num_blocks,
+            "block_size": self.cfg.block_size,
+            "used_blocks": self.used_blocks,
+            "free_blocks": len(self._free),
+            "cached_blocks": self.cached_blocks,
+            "reserved_blocks": self._reserved,
+            "live_sequences": len(self._tables),
+            "utilization": round(self.utilization, 4),
+            "high_water_blocks": s.high_water_blocks,
+            "allocated_total": s.allocated_total,
+            "freed_total": s.freed_total,
+            "prefix_hit_blocks": s.prefix_hit_blocks,
+            "prefix_hit_tokens": s.prefix_hit_tokens,
+            "prefix_evicted_blocks": s.prefix_evicted_blocks,
+            "cow_copies": s.cow_copies,
+        }
+
     def num_allocated(self, seq_id) -> int:
         return len(self._tables[seq_id])

@@ -1,0 +1,168 @@
+"""Instrumentation plane for serve/llm: one clock, serving-latency
+histograms, and the engine flight recorder.
+
+Design constraints (ISSUE 4 / docs/OBSERVABILITY.md):
+
+- **One clock.** Every duration the engine records — step latency
+  histograms, flight-recorder records, event_stats — flows through
+  ``clock()`` (monotonic), and every absolute timestamp (timelines,
+  spans, chrome export) through ``wall()``. tests/test_sanitizers.py
+  lints serve/llm for stray ``time.time()`` / ``time.perf_counter()``
+  calls outside this module, so the records can never disagree about
+  what was measured.
+- **Zero device syncs.** Nothing here touches jax values; the engine's
+  single device->host sync point (``_host_logits``) is unchanged.
+- **O(1) per step.** The flight recorder is a ``deque(maxlen=N)`` ring:
+  one dict append per step, old records drop off the far end. Dumping is
+  a read-only snapshot, safe from the lock-free watchdog thread (a
+  ``list(deque)`` copy is atomic under the GIL) — the whole point is
+  explaining a step that wedged while holding the scheduler lock.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import time
+from collections import deque
+
+from ray_tpu._private import event_stats
+from ray_tpu.util import metrics
+
+logger = logging.getLogger("ray_tpu.serve.llm")
+
+# THE two clocks: monotonic for durations, wall for timestamps that must
+# line up across processes (timelines, spans, chrome export).
+clock = time.perf_counter
+wall = time.time
+
+# Serving-appropriate buckets: TTFT spans "prefix-hit tiny model" (ms) to
+# "cold 70B prefill" (tens of seconds); per-output-token tracks decode
+# step cadence; queue wait tracks admission backpressure.
+TTFT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
+QUEUE_WAIT_BUCKETS = (0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 20.0)
+
+
+def ttft_histogram() -> metrics.Histogram:
+    return metrics.histogram(
+        "llm_ttft_seconds",
+        "Time from submit() to the first generated token",
+        boundaries=TTFT_BUCKETS,
+    )
+
+
+def tpot_histogram() -> metrics.Histogram:
+    return metrics.histogram(
+        "llm_time_per_output_token_seconds",
+        "Gap between consecutive generated tokens of one request",
+        boundaries=TPOT_BUCKETS,
+    )
+
+
+def queue_wait_histogram() -> metrics.Histogram:
+    return metrics.histogram(
+        "llm_queue_wait_seconds",
+        "Time a request waited for admission (submit -> admitted)",
+        boundaries=QUEUE_WAIT_BUCKETS,
+    )
+
+
+def compile_counter() -> metrics.Counter:
+    return metrics.counter(
+        "llm_compile_events",
+        "New jit signatures seen by this engine's DecodeFns, by shape key",
+        tag_keys=("shape",),
+    )
+
+
+def shape_key(sig: tuple) -> str:
+    """Stable label for one (kind, tokens_shape, tables_shape) signature,
+    e.g. ``prefill_chunk:4x32:4x8`` — bounded cardinality because shapes
+    are drawn from the closed bucket ladders."""
+    kind, tok, tbl = sig
+    return (
+        f"{kind}:{'x'.join(str(d) for d in tok)}:"
+        f"{'x'.join(str(d) for d in tbl)}"
+    )
+
+
+class FlightRecorder:
+    """Bounded ring of per-step records for post-mortem debugging.
+
+    ``record()`` appends one dict (phase, bucket shape, admission/eviction
+    counts, duration, KV utilization — built by the engine under its
+    lock); ``dump()`` packages the ring plus the process's event_stats
+    into one JSON-safe dict. Dumped on ``EngineDiedError``, watchdog
+    timeout, ``shutdown(dump=...)``, ``engine.debug_dump()`` and the
+    proxy's ``/debug/llm`` endpoint.
+    """
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(1, int(capacity))
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self._steps = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, rec: dict) -> None:
+        """O(1): one append; the ring evicts from the far end."""
+        self._steps += 1
+        rec["step"] = self._steps
+        self._ring.append(rec)
+
+    def snapshot(self) -> list[dict]:
+        # list(deque) is a GIL-atomic copy — safe without the engine lock
+        # (the watchdog dumps while the wedged stepper still holds it)
+        return list(self._ring)
+
+    def dump(self, reason: str, extra: dict | None = None) -> dict:
+        out = {
+            "reason": reason,
+            "ts": wall(),
+            "pid": os.getpid(),
+            "steps_total": self._steps,
+            "capacity": self.capacity,
+            "steps": self.snapshot(),
+            "event_stats": event_stats.snapshot(),
+        }
+        if extra:
+            out.update(extra)
+        return out
+
+
+def dump_dir(explicit: str | None = None) -> str:
+    """Where flight-recorder JSON lands: the engine's configured dir, else
+    ``RAY_TPU_FLIGHT_DIR``, else ``<tmp>/ray_tpu_flight``."""
+    return (
+        explicit
+        or os.environ.get("RAY_TPU_FLIGHT_DIR")
+        or os.path.join(tempfile.gettempdir(), "ray_tpu_flight")
+    )
+
+
+def write_dump(
+    dump: dict, *, dir: str | None = None, path: str | None = None
+) -> str | None:
+    """Serialize one flight-recorder dump to disk. Best-effort by
+    contract: the dump happens while the engine is dying, and
+    observability must never turn a clean failure fan-out into a crash —
+    returns the path, or None when the write failed."""
+    try:
+        if path is None:
+            d = dump_dir(dir)
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d,
+                f"llm_flight_{os.getpid()}_{int(wall() * 1000)}.json",
+            )
+        with open(path, "w") as f:
+            json.dump(dump, f, indent=1, default=str)
+        return path
+    except Exception as e:  # noqa: BLE001 — never fail the failure path
+        logger.warning("flight-recorder dump failed: %r", e)
+        return None

@@ -9,13 +9,17 @@ handler results are returned as JSON.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import itertools
 import json
+import logging
 import threading
+import time
 import uuid
 from typing import Any
 
 import ray_tpu
+from ray_tpu._private import event_stats
 from ray_tpu.exceptions import (
     DeadlineExceededError,
     EngineOverloadedError,
@@ -24,6 +28,41 @@ from ray_tpu.exceptions import (
 )
 from ray_tpu.serve.config import HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponseGenerator
+from ray_tpu.util import tracing
+
+# Structured access logs (one JSON object per line) shared by the HTTP and
+# gRPC proxies — docs/OBSERVABILITY.md "Access logs".
+_access_logger = logging.getLogger("ray_tpu.serve.access")
+
+# Request header (HTTP) / metadata key (gRPC) that opts a call into
+# tracing; the assigned trace id is echoed back on this response header.
+TRACE_HEADER = "x-ray-tpu-trace"
+TRACE_ID_HEADER = "x-ray-tpu-trace-id"
+
+
+def log_access(proxy: str, path: str, state: dict, *, status: str,
+               error: str | None = None) -> None:
+    """Emit one structured access-log line. ``state`` accumulates during
+    the request: t0 (perf-counter start), request_id, trace_id, ttft_ms,
+    tokens, resumed. Idempotent — streams can hit both the handler's error
+    path and the pump's completion path."""
+    if state.get("_logged"):
+        return
+    state["_logged"] = True
+    dur = time.perf_counter() - state["t0"] if "t0" in state else 0.0
+    event_stats.record(f"serve.proxy.{proxy}.request", dur)
+    _access_logger.info(json.dumps({
+        "proxy": proxy,
+        "path": path,
+        "request_id": state.get("request_id"),
+        "trace_id": state.get("trace_id"),
+        "status": status,
+        "ttft_ms": state.get("ttft_ms"),
+        "tokens": state.get("tokens", 0),
+        "resumed": state.get("resumed", 0),
+        "duration_ms": round(dur * 1000.0, 3),
+        "error": error,
+    }, default=str))
 
 
 def _unwrap(e: BaseException) -> BaseException:
@@ -138,7 +177,8 @@ class HTTPProxy:
             return json.dumps(chunk).encode() + b"\n"
 
         async def stream_response(request, response_gen,
-                                  on_disconnect=None) -> "web.StreamResponse":
+                                  on_disconnect=None,
+                                  headers=None) -> "web.StreamResponse":
             """Pump chunks from the blocking DeploymentResponseGenerator
             (iterated on an executor thread) out the socket as they arrive
             — token streaming for LLM decode (reference:
@@ -147,6 +187,8 @@ class HTTPProxy:
             transfer otherwise."""
             sse = "text/event-stream" in request.headers.get("Accept", "")
             resp = web.StreamResponse()
+            if headers:
+                resp.headers.update(headers)
             resp.content_type = ("text/event-stream" if sse
                                  else "application/octet-stream")
             resp.enable_chunked_encoding()
@@ -194,7 +236,40 @@ class HTTPProxy:
                 raise
             return resp
 
+        async def debug_llm(request: web.Request) -> web.Response:
+            """GET /debug/llm?app=<name>: broadcast ``debug_dump()`` to
+            every replica of the app's ingress deployment — flight-recorder
+            snapshot + scheduler/cache stats per replica, as JSON (None
+            where a replica failed or lacks the method)."""
+            app_name = request.query.get("app", "default")
+            with self._routes_lock:
+                apps = {a: ing for (a, ing) in self._routes.values()}
+            ingress = apps.get(app_name)
+            if ingress is None:
+                return web.json_response(
+                    {"error": f"unknown app {app_name!r}",
+                     "apps": sorted(apps)},
+                    status=404,
+                )
+
+            def dump_blocking():
+                return DeploymentHandle(ingress, app_name).broadcast(
+                    "debug_dump")
+
+            try:
+                dumps = await asyncio.get_event_loop().run_in_executor(
+                    None, dump_blocking
+                )
+            except Exception as e:  # noqa: BLE001 — surface to the client
+                return web.json_response({"error": str(e)}, status=500)
+            return web.json_response(
+                {"app": app_name, "replicas": dumps},
+                dumps=lambda o: json.dumps(o, default=str),
+            )
+
         async def handler(request: web.Request) -> web.Response:
+            if request.path == "/debug/llm":
+                return await debug_llm(request)
             target = self._match(request.path)
             if target is None:
                 return web.json_response(
@@ -215,34 +290,49 @@ class HTTPProxy:
             # ingresses the first chunk is ALSO fetched there, so admission
             # and deadline errors map to a status code before the response
             # headers go out; remaining chunks are pumped by stream_response.
-            state: dict[str, Any] = {}
+            traced = TRACE_HEADER in request.headers
+            state: dict[str, Any] = {"t0": time.perf_counter()}
 
             def call_blocking():
                 nonlocal payload
-                handle = DeploymentHandle(ingress, app_name).options(
-                    stream_chunk_timeout_s=self.options.request_timeout_s)
-                if isinstance(payload, dict):
-                    try:
-                        streaming_ingress = "__call__" in handle.stream_methods()
-                    except Exception:  # noqa: BLE001 — best-effort tag
-                        streaming_ingress = False
-                    if streaming_ingress:
-                        # tag the request so a client disconnect can cancel
-                        # it on whichever replica is serving the stream
-                        payload = dict(payload)
-                        payload.setdefault("request_id", uuid.uuid4().hex)
-                        state["request_id"] = payload["request_id"]
-                        state["handle"] = handle
-                response = handle.remote(payload)
-                if isinstance(response, DeploymentResponseGenerator):
-                    it = iter(response)
-                    try:
-                        first = next(it)
-                    except StopIteration:
-                        return _PrefetchedStream(())
-                    return _PrefetchedStream(itertools.chain([first], it))
-                return response.result(
-                    timeout=self.options.request_timeout_s)
+                # run_in_executor does NOT propagate contextvars, so the
+                # root span must open HERE on the executor thread — the
+                # dispatch below captures trace_ctx from it into the spec
+                root = (
+                    tracing.span("http.request", path=request.path,
+                                 method=request.method)
+                    if traced else contextlib.nullcontext({})
+                )
+                with root as ctx:
+                    if ctx.get("trace_id"):
+                        state["trace_id"] = ctx["trace_id"]
+                    handle = DeploymentHandle(ingress, app_name).options(
+                        stream_chunk_timeout_s=self.options.request_timeout_s)
+                    if isinstance(payload, dict):
+                        try:
+                            streaming_ingress = (
+                                "__call__" in handle.stream_methods())
+                        except Exception:  # noqa: BLE001 — best-effort tag
+                            streaming_ingress = False
+                        if streaming_ingress:
+                            # tag the request so a client disconnect can
+                            # cancel it on whichever replica is serving it
+                            payload = dict(payload)
+                            payload.setdefault("request_id", uuid.uuid4().hex)
+                            state["request_id"] = payload["request_id"]
+                            state["handle"] = handle
+                    response = handle.remote(payload)
+                    if isinstance(response, DeploymentResponseGenerator):
+                        it = iter(response)
+                        try:
+                            first = next(it)
+                        except StopIteration:
+                            return _PrefetchedStream(())
+                        state["ttft_ms"] = round(
+                            (time.perf_counter() - state["t0"]) * 1000.0, 3)
+                        return _PrefetchedStream(itertools.chain([first], it))
+                    return response.result(
+                        timeout=self.options.request_timeout_s)
 
             try:
                 result = await asyncio.get_event_loop().run_in_executor(
@@ -250,10 +340,16 @@ class HTTPProxy:
                 )
             except Exception as e:  # noqa: BLE001 — surface to the client
                 status, headers = _status_for(e)
+                log_access("http", request.path, state,
+                           status=str(status), error=str(e))
                 return web.json_response(
                     {"error": str(e)}, status=status, headers=headers)
+            trace_headers = ({TRACE_ID_HEADER: state["trace_id"]}
+                             if "trace_id" in state else None)
             if isinstance(result, _PrefetchedStream):
                 def on_disconnect():
+                    log_access("http", request.path, state,
+                               status="disconnect")
                     rid = state.get("request_id")
                     handle = state.get("handle")
                     if rid is None or handle is None:
@@ -263,10 +359,28 @@ class HTTPProxy:
                         daemon=True, name="serve-cancel",
                     ).start()
 
-                return await stream_response(request, result, on_disconnect)
+                def counted(chunks):
+                    # runs on the pump thread: count chunks out and emit
+                    # the access-log line when the stream actually ends
+                    try:
+                        for c in chunks:
+                            state["tokens"] = state.get("tokens", 0) + 1
+                            yield c
+                    except BaseException as e:
+                        log_access("http", request.path, state,
+                                   status="error", error=str(e))
+                        raise
+                    log_access("http", request.path, state, status="200")
+
+                return await stream_response(
+                    request, _PrefetchedStream(counted(result.chunks)),
+                    on_disconnect, headers=trace_headers)
+            log_access("http", request.path, state, status="200")
             if isinstance(result, (dict, list, str, int, float, bool, type(None))):
-                return web.json_response({"result": result})
-            return web.json_response({"result": repr(result)})
+                return web.json_response({"result": result},
+                                         headers=trace_headers)
+            return web.json_response({"result": repr(result)},
+                                     headers=trace_headers)
 
         loop = asyncio.new_event_loop()
         self._loop = loop

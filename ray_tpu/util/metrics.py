@@ -80,9 +80,10 @@ class Gauge(_Metric):
 class Histogram(_Metric):
     def __init__(self, name, description="", boundaries=(), tag_keys=()):
         super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(boundaries)
         kwargs = {"registry": _get_registry(), "labelnames": self.tag_keys}
         if boundaries:
-            kwargs["buckets"] = tuple(boundaries)
+            kwargs["buckets"] = self.boundaries
         self._h = _prom.Histogram(name, description, **kwargs)
 
     def observe(self, value: float, tags: dict | None = None):
@@ -109,6 +110,23 @@ def _get_named(cls, name: str, description: str, tag_keys, **kwargs):
                 f"metric {name!r} already registered as {type(m).__name__}, "
                 f"requested {cls.__name__}"
             )
+        else:
+            # same fail-loudly contract as the kind check: handing back an
+            # instrument whose schema differs from what the caller asked
+            # for would silently mislabel (tag_keys) or misbucket
+            # (boundaries) every later observation
+            if tuple(tag_keys) != m.tag_keys:
+                raise ValueError(
+                    f"metric {name!r} already registered with tag_keys="
+                    f"{m.tag_keys}, requested {tuple(tag_keys)}"
+                )
+            if isinstance(m, Histogram):
+                want = tuple(kwargs.get("boundaries") or ())
+                if want != m.boundaries:
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"boundaries={m.boundaries}, requested {want}"
+                    )
         return m
 
 

@@ -70,6 +70,41 @@ def _record(name: str, trace_id: str, span_id: str,
     )
 
 
+def record_span(
+    name: str,
+    *,
+    trace_id: str,
+    parent_span_id: str | None = None,
+    start: float,
+    end: float,
+    attrs: dict | None = None,
+    kind: str = "span",
+) -> str:
+    """Record a completed span from an explicitly-carried trace context.
+
+    For instrumentation that cannot hold a contextvar open across the
+    span's lifetime — e.g. the serve/llm engine, whose request phases run
+    on the scheduler thread long after the submitting call returned. The
+    caller supplies the stored context and the measured start/end wall
+    times; returns the new span id (so phase spans can parent under a
+    request span recorded in the same batch)."""
+    span_id = os.urandom(8).hex()
+    _record(name, trace_id, span_id, parent_span_id, start, end, attrs, kind)
+    return span_id
+
+
+@contextmanager
+def span_if_active(name: str, **attrs: Any):
+    """Like ``span`` but a no-op when no trace is active: hot paths (the
+    serve router, proxies) instrument with this so untraced traffic pays
+    one contextvar read and nothing else."""
+    if _current.get() is None:
+        yield None
+        return
+    with span(name, **attrs) as ctx:
+        yield ctx
+
+
 @contextmanager
 def span(name: str, **attrs: Any):
     """Open a span; nests under the active one; records on exit."""
@@ -125,7 +160,11 @@ def trace_to_chrome(trace_id: str, filename: str | None = None):
     for e in sorted(get_trace(trace_id), key=lambda e: e["start"]):
         events.append({
             "name": e["name"],
-            "cat": e.get("type", "span"),
+            # the span kind rides the event's task_type slot — the buffer
+            # stores it under "type"; accept either key so replayed/legacy
+            # events still categorize (regression: tests/test_tracing.py
+            # asserts cat == "task" for task-execution spans)
+            "cat": e.get("type") or e.get("task_type") or "span",
             "ph": "X",
             "ts": e["start"] * 1e6,
             "dur": (e["end"] - e["start"]) * 1e6,

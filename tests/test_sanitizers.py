@@ -181,6 +181,41 @@ def test_device_logits_cross_host_only_on_emit_path():
     )
 
 
+def test_one_clock_in_llm_serving_path():
+    """Observability lint (ISSUE 4): every duration/timestamp in
+    serve/llm flows through obs.clock / obs.wall — a stray
+    ``time.time()`` or ``time.perf_counter()`` elsewhere in the engine
+    produces step records, histograms, and timelines that disagree about
+    what was measured. ``time.monotonic``/``time.sleep`` stay allowed
+    (deadline math and the watchdog poll are not measurements)."""
+    import ast
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    targets = sorted((root / "ray_tpu" / "serve" / "llm").rglob("*.py"))
+    assert targets, "serving path sources not found"
+    forbidden = {"time", "perf_counter"}
+    offenders = []
+    for path in targets:
+        if path.name == "obs.py":
+            continue  # THE clock module
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in forbidden
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"
+            ):
+                offenders.append(f"{path.relative_to(root)}:{node.lineno}")
+    assert not offenders, (
+        f"raw clock reads outside serve/llm/obs.py: {offenders}"
+    )
+
+
 SCHED_DRIVER = r"""
 #include <cstdint>
 #include <cstdio>

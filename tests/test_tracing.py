@@ -48,9 +48,15 @@ def test_task_execution_becomes_child_span(ray_start):
     parent = [s for s in spans if s["name"] == "driver-block"][0]
     assert task_span["parent_span_id"] == parent["span_id"]
     assert task_span["type"] == "task"
-    # chrome export shape
+    # chrome export shape — and the category regression: the span kind is
+    # stored under the event's "type" slot, and a task-execution span must
+    # export as cat="task" (not the generic "span" fallback) so chrome's
+    # category filter separates app spans from task spans
     events = tracing.trace_to_chrome(trace_id)
     assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+    cats = {e["name"]: e["cat"] for e in events}
+    assert cats["traced_work"] == "task"
+    assert cats["driver-block"] == "span"
 
 
 def test_untraced_tasks_record_no_spans(ray_start):
