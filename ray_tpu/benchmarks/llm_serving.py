@@ -19,7 +19,13 @@ Reported (one JSON line, merged into bench.py's aux results under
                               waves — cache hits retire tokens without
                               computing them, so this is the number the
                               prefix cache actually moves
-- ``llm_decode_tokens_per_sec``   generated tokens / decode wall time
+- ``llm_decode_tokens_per_sec``   steady-state decode throughput: a
+                              fixed full batch decoding long tails, so
+                              the dispatch-ahead pipeline (engine.py)
+                              sits on its lag-1 fast path — generated
+                              tokens / decode-step wall time
+- ``llm_decode_step_p50_ms``  median wall time of one steady decode
+                              step (dispatch + lagged O(batch) sync)
 
 Runs on CPU with the tiny llama config — the point is tracking the
 scheduler/cache overheads and the hit-rate plumbing release-over-release,
@@ -35,6 +41,9 @@ TAIL_TOKENS = 4
 WAVES = 4           # first wave is cold, the rest hit the prefix cache
 WAVE_REQUESTS = 8
 MAX_NEW_TOKENS = 8
+# long enough to dominate with steady decode steps, short enough to stay
+# inside the context bucket the warm waves already compiled (96+4+24 < 128)
+STEADY_NEW_TOKENS = 24
 
 
 def run_serving_bench() -> dict:
@@ -106,6 +115,31 @@ def run_serving_bench() -> dict:
         ) + (
             after["prefill_tokens_total"] - before["prefill_tokens_total"]
         )
+    # steady-state decode: one full batch, identical budgets — after the
+    # shared prefill the running set never changes, so every decode step
+    # is the pipelined path (dispatch N+1, then sync step N's tokens)
+    steady_streams = [
+        eng.submit(
+            prefix
+            + [int(t) for t in rng.integers(1, mc.vocab_size, TAIL_TOKENS)],
+            max_new_tokens=STEADY_NEW_TOKENS,
+        )
+        for _ in range(WAVE_REQUESTS)
+    ]
+    steady_step_s: list[float] = []
+    for _ in range(10_000):
+        if all(s.done for s in steady_streams):
+            break
+        t0 = time.perf_counter()
+        if not eng.step():
+            break
+        dt = time.perf_counter() - t0
+        if eng.last_step_kind == "decode":
+            steady_step_s.append(dt)
+    while eng.step():  # collapse the trailing in-flight step
+        pass
+    steady_tokens = sum(len(list(s)) for s in steady_streams)
+
     st = eng.stats()
     generated = (WAVES - 1) * WAVE_REQUESTS * MAX_NEW_TOKENS
     # Per-request serving latencies straight off the engine's timelines
@@ -135,8 +169,17 @@ def run_serving_bench() -> dict:
             warm_prompt_tokens / max(warm_prefill_s, 1e-9), 1
         ),
         "llm_decode_tokens_per_sec": round(
+            steady_tokens / max(sum(steady_step_s), 1e-9), 1
+        ),
+        "llm_decode_step_p50_ms": round(
+            float(np.percentile(steady_step_s, 50)) * 1e3, 3
+        )
+        if steady_step_s else None,
+        "llm_warm_decode_tokens_per_sec": round(
             generated / max(warm_decode_s, 1e-9), 1
         ),
+        "llm_host_sync_bytes_total": st["host_sync_bytes_total"],
+        "llm_host_sync_seconds_total": st["host_sync_seconds_total"],
         "llm_ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 3)
         if ttfts else None,
         "llm_ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 3)

@@ -317,13 +317,17 @@ def gpt_prefill(
     block_tables: jax.Array,
     cfg: GPTConfig,
     start: jax.Array | None = None,
+    sample: dict | None = None,
 ):
     """Prompt pass: run the causal forward over right-padded prompts,
     writing every valid position's K/V into the paged cache.
 
     tokens [B, S] int32, lengths [B] (valid prefix per row; padding rows
     use length 1 + an all-garbage block table), block_tables [B, NB].
-    Returns (last-valid-token logits [B, V] f32, cache_k', cache_v').
+    Returns (last-valid-token logits [B, V] f32, cache_k', cache_v');
+    with a ``sample`` pytree (ops/sampling.py) sampling fuses into the
+    jitted program and (sampled first tokens [B] int32, cache_k',
+    cache_v') comes back instead — logits never leave the device.
 
     ``start=None``: the whole prompt starts at position 0 and attention is
     the XLA reference kernel over the chunk alone — prefill happens once
@@ -385,7 +389,15 @@ def gpt_prefill(
         "bd,vd->bv", h_last.astype(cfg.dtype), params["wte"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits, cache_k, cache_v
+    if sample is None:
+        return logits, cache_k, cache_v
+    from ray_tpu.ops.sampling import sample_tokens
+
+    # the new token lands right after the last valid prompt token
+    new_pos = (lengths if start is None else start + lengths).astype(
+        jnp.int32
+    )
+    return sample_tokens(logits, new_pos, sample), cache_k, cache_v
 
 
 def gpt_decode_step(
@@ -396,6 +408,7 @@ def gpt_decode_step(
     positions: jax.Array,
     block_tables: jax.Array,
     cfg: GPTConfig,
+    sample: dict | None = None,
 ):
     """One incremental decode step for a batch of sequences.
 
@@ -403,7 +416,9 @@ def gpt_decode_step(
     logical position), block_tables [B, NB]. Writes the token's K/V, then
     attends over the gathered paged context (mask includes self). Padding
     rows point at the garbage block with position 0.
-    Returns (next-token logits [B, V] f32, cache_k', cache_v').
+    Returns (next-token logits [B, V] f32, cache_k', cache_v'); with a
+    ``sample`` pytree the logits never leave the device — returns
+    (sampled tokens [B] int32, cache_k', cache_v').
     """
     from ray_tpu.ops.kv_cache import paged_attention, write_kv
 
@@ -435,7 +450,11 @@ def gpt_decode_step(
         "bd,vd->bv", h.astype(cfg.dtype), params["wte"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits, cache_k, cache_v
+    if sample is None:
+        return logits, cache_k, cache_v
+    from ray_tpu.ops.sampling import sample_tokens
+
+    return sample_tokens(logits, positions + 1, sample), cache_k, cache_v
 
 
 def gpt_num_params(cfg: GPTConfig) -> int:

@@ -364,9 +364,13 @@ def llama_prefill(
     block_tables: jax.Array,
     cfg: LlamaConfig,
     start: jax.Array | None = None,
+    sample: dict | None = None,
 ):
     """Prompt pass with paged-cache writes; see gpt_prefill. Returns
-    (last-valid-token logits [B, V] f32, cache_k', cache_v').
+    (last-valid-token logits [B, V] f32, cache_k', cache_v') — or, with a
+    ``sample`` pytree (ops/sampling.py), (sampled first tokens [B] int32,
+    cache_k', cache_v'): sampling fuses into the jitted program and only
+    token ids ever cross to host.
 
     ``start=None`` (the whole-prompt path): RoPE runs at positions 0..S-1
     and attention is the causal reference kernel over the chunk alone.
@@ -430,7 +434,15 @@ def llama_prefill(
         params["lm_head"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits, cache_k, cache_v
+    if sample is None:
+        return logits, cache_k, cache_v
+    from ray_tpu.ops.sampling import sample_tokens
+
+    # the new token lands right after the last valid prompt token
+    new_pos = (lengths if start is None else start + lengths).astype(
+        jnp.int32
+    )
+    return sample_tokens(logits, new_pos, sample), cache_k, cache_v
 
 
 def llama_decode_step(
@@ -441,10 +453,13 @@ def llama_decode_step(
     positions: jax.Array,
     block_tables: jax.Array,
     cfg: LlamaConfig,
+    sample: dict | None = None,
 ):
     """One incremental decode step; see gpt_decode_step. RoPE is applied at
     the TRUE sequence position via the `positions` arg of ops/layers.rope.
-    Returns (next-token logits [B, V] f32, cache_k', cache_v')."""
+    Returns (next-token logits [B, V] f32, cache_k', cache_v'); with a
+    ``sample`` pytree the logits never leave the device — returns
+    (sampled tokens [B] int32, cache_k', cache_v')."""
     from ray_tpu.ops.kv_cache import paged_attention, write_kv
 
     B = tokens.shape[0]
@@ -474,7 +489,11 @@ def llama_decode_step(
         "bd,dv->bv", h.astype(cfg.dtype), params["lm_head"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits, cache_k, cache_v
+    if sample is None:
+        return logits, cache_k, cache_v
+    from ray_tpu.ops.sampling import sample_tokens
+
+    return sample_tokens(logits, positions + 1, sample), cache_k, cache_v
 
 
 def llama_num_params(cfg: LlamaConfig) -> int:

@@ -1,0 +1,102 @@
+"""Fused on-device token sampling for the serve/llm decode pipeline.
+
+``sample_tokens`` turns a batch of next-token logits into sampled token
+ids INSIDE the jitted model step (models/gpt.py, models/llama.py call it
+when the engine passes a ``sample`` pytree), so the per-token
+device->host transfer shrinks from O(batch x vocab) float32 logits to
+O(batch) int32 ids and the host never touches a probability.
+
+Determinism contract (the engine's failover story depends on it): the
+per-token randomness is *stateless per (seed, position)* —
+
+    key = fold_in(PRNGKey(request_seed), absolute_position_of_new_token)
+
+so the token at position p is a pure function of (logits, seed, p). A
+mid-stream resume that re-prefills ``prompt + delivered`` reproduces the
+remaining tokens byte-identically by construction; no RNG state needs
+fast-forwarding (this replaces the old host-side "burn one numpy uniform
+per token" contract).
+
+Kernel shape (TPU-friendly, no data-dependent shapes): the non-greedy
+path sorts each row once with ``jax.lax.top_k(scaled, V)`` — a full
+descending sort — then applies top-k as a rank mask, top-p as an
+exclusive-cumsum mask over the sorted probabilities, and draws via
+inverse CDF on the renormalized sorted distribution. Greedy rows
+(temperature <= 0 or top_k == 1) are argmax; when the WHOLE batch is
+greedy a ``lax.cond`` skips the sort entirely (the common serving
+config), keeping the fused step as cheap as the old logits-returning one.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _sampled_row(
+    logits: jax.Array,
+    seed: jax.Array,
+    position: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """One row of the full temperature/top-k/top-p path. All inputs are
+    scalars except ``logits`` [V]; returns a scalar int32 token id."""
+    V = logits.shape[-1]
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), position)
+    u = jax.random.uniform(key, dtype=jnp.float32)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(
+        temperature, jnp.float32(1e-6)
+    )
+    # full descending sort: rank r holds the (r+1)-th largest logit
+    srt, idx = jax.lax.top_k(scaled, V)
+    ranks = jnp.arange(V, dtype=jnp.int32)
+    k_eff = jnp.where(top_k > 0, top_k, V)
+    srt = jnp.where(ranks < k_eff, srt, -jnp.inf)
+    probs = jax.nn.softmax(srt)
+    # top-p over the sorted distribution: keep ranks whose EXCLUSIVE
+    # cumulative mass is below p (rank 0 always survives, so a tiny p
+    # degrades to greedy rather than an empty support)
+    p_eff = jnp.where((top_p > 0.0) & (top_p < 1.0), top_p, jnp.float32(1.0))
+    keep = (jnp.cumsum(probs) - probs) < p_eff
+    srt = jnp.where(keep, srt, -jnp.inf)
+    probs = jax.nn.softmax(srt)
+    pick = jnp.minimum(
+        jnp.searchsorted(jnp.cumsum(probs), u, side="right"), V - 1
+    )
+    return idx[pick].astype(jnp.int32)
+
+
+def sample_tokens(
+    logits: jax.Array,
+    positions: jax.Array,
+    sample: dict,
+) -> jax.Array:
+    """Sample one token per row of ``logits`` [B, V] f32.
+
+    ``positions`` [B] int32 is the ABSOLUTE sequence position of the token
+    being sampled (prompt tokens occupy 0..len(prompt)-1, so the first
+    generated token sits at len(prompt)). ``sample`` is a pytree of [B]
+    arrays: ``seeds`` (uint32), ``temperature`` (f32, <= 0 -> greedy),
+    ``top_k`` (int32, 0 -> full distribution), ``top_p`` (f32, >= 1 or
+    <= 0 -> disabled). Returns [B] int32 token ids.
+    """
+    seeds = sample["seeds"]
+    temperature = sample["temperature"]
+    top_k = sample["top_k"]
+    top_p = sample["top_p"]
+    greedy_rows = (temperature <= 0.0) | (top_k == 1)
+    # jnp.argmax matches np.argmax tie-breaking (first occurrence), which
+    # is what the greedy-parity test pins down
+    greedy_toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def all_greedy(_):
+        return greedy_toks
+
+    def mixed(_):
+        sampled = jax.vmap(_sampled_row)(
+            logits, seeds, positions, temperature, top_k, top_p
+        )
+        return jnp.where(greedy_rows, greedy_toks, sampled)
+
+    return jax.lax.cond(jnp.all(greedy_rows), all_greedy, mixed, None)

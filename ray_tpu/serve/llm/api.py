@@ -25,8 +25,9 @@ Failure semantics (docs/SERVING_LLM.md): every chunk carries
 so a client (``stream_tokens`` / ``DeploymentHandle.stream_with_failover``)
 can resume a stream on a surviving replica after this one dies: it
 re-submits ``prompt`` plus ``prior_tokens`` (the tokens it already has)
-and the engine re-prefills and fast-forwards the sampling RNG, making the
-resumed stream byte-identical to an uninterrupted one.
+and the engine re-prefills; sampling is keyed per (seed, absolute
+position) on device, so the resumed stream is byte-identical to an
+uninterrupted one by construction — no RNG state to replay.
 """
 from __future__ import annotations
 
@@ -79,7 +80,8 @@ class LLMDeployment:
         """Generator: one chunk per generated token.
 
         payload: {"prompt": str | [int], "max_new_tokens"?, "temperature"?,
-        "top_k"?, "seed"?, "request_id"?, "deadline_s"?, "prior_tokens"?}.
+        "top_k"?, "top_p"?, "seed"?, "request_id"?, "deadline_s"?,
+        "prior_tokens"?}.
         Chunks: {"request_id": str, "token": id, "index": i, "text": str}
         where ``index`` is absolute — a resumed stream continues the
         numbering of the stream it replaces.
@@ -102,6 +104,7 @@ class LLMDeployment:
             max_new_tokens=max_new - len(prior),
             temperature=float(payload.get("temperature", 0.0)),
             top_k=int(payload.get("top_k", 0)),
+            top_p=float(payload.get("top_p", 1.0)),
             seed=int(payload.get("seed", 0)),
             deadline_s=float(deadline_s) if deadline_s is not None else None,
             start_index=len(prior),

@@ -87,32 +87,40 @@ class DecodeFns:
 
     def prefill(
         self, params, cache_k, cache_v, tokens, lengths, block_tables,
-        start=None,
+        start=None, sample=None,
     ):
         # start=None is the monolithic whole-prompt path (positions are
         # arange over the chunk, reference-attention formulation); a [B]
         # start array is the chunked/prefix path (true positions, paged
         # attention over already-resident context). The two trace to
         # different programs, so they get distinct signature kinds.
+        # ``sample`` (a pytree of [B] arrays, ops/sampling.py) fuses
+        # sampling into the SAME kind — it swaps the program's epilogue
+        # (token ids out instead of logits), not its signature, so the
+        # compile-count contract stays (prefill, prefill_chunk, decode)
+        # x batch_buckets x length_buckets.
         kind = "prefill" if start is None else "prefill_chunk"
         self._note(
             (kind, tuple(tokens.shape), tuple(block_tables.shape))
         )
         if start is None:
             return self._prefill(
-                params, cache_k, cache_v, tokens, lengths, block_tables
+                params, cache_k, cache_v, tokens, lengths, block_tables,
+                sample=sample,
             )
         return self._prefill(
             params, cache_k, cache_v, tokens, lengths, block_tables,
-            start=start,
+            start=start, sample=sample,
         )
 
-    def decode(self, params, cache_k, cache_v, tokens, positions, block_tables):
+    def decode(self, params, cache_k, cache_v, tokens, positions,
+               block_tables, sample=None):
         self._note(
             ("decode", tuple(tokens.shape), tuple(block_tables.shape))
         )
         return self._decode(
-            params, cache_k, cache_v, tokens, positions, block_tables
+            params, cache_k, cache_v, tokens, positions, block_tables,
+            sample=sample,
         )
 
     @property

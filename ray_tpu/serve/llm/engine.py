@@ -32,14 +32,28 @@ bounded signature family ("prefill_chunk") next to the monolithic
 "prefill" and "decode" kinds. `DecodeFns.num_compiled_shapes` reports the
 realized count.
 
-Sampling runs on host (numpy) per request — greedy, temperature, top-k —
-with a per-request RNG so a sequence's output is identical whether it ran
-solo or continuously batched with arbitrary neighbors. The RNG consumes
-exactly one uniform per token ON EVERY PATH (greedy included — its argmax
-ignores the draw, but burning it keeps the RNG position a pure function
-of tokens produced), which is what makes mid-stream failover
-byte-identical: a resumed request sets ``start_index`` and the fresh
-engine fast-forwards the RNG past the tokens already delivered.
+Sampling is FUSED into the jitted model step (ops/sampling.py): greedy,
+temperature, top-k and top-p all run on device, so the per-token
+device->host transfer is O(batch) int32 token ids instead of
+O(batch x vocab) float32 logits. Per-token randomness is keyed, not
+stateful: token position p draws from
+``fold_in(PRNGKey(request_seed), p)``, making every sampled token a pure
+function of (logits, seed, position). A sequence's output is therefore
+identical whether it ran solo or continuously batched with arbitrary
+neighbors, and mid-stream failover is byte-identical BY CONSTRUCTION — a
+resumed request re-prefills ``prompt + delivered`` and the keyed draws
+at the remaining positions are unchanged (this replaces the old
+host-side "burn one numpy uniform per token" RNG contract).
+
+The decode loop is pipelined with a one-step sync lag (dispatch-ahead,
+arXiv 2011.03641): step N+1's decode feeds DIRECTLY from step N's
+on-device sampled-token array, and the host syncs token ids one step
+behind, so bucketing, block-table/COW assembly and scheduler work hide
+under device compute via JAX async dispatch. Terminal conditions (EOS,
+max_tokens, cancel, deadline) are reconciled when the lagged tokens
+arrive — at most one wasted speculative row per just-finished request —
+and KV blocks freed while a dispatch is in flight are quarantined until
+the next sync proves the dispatch executed (kv_cache.flush_quarantine).
 
 Failure semantics (docs/SERVING_LLM.md "Failure semantics"):
 
@@ -96,6 +110,7 @@ class SamplingParams:
     max_new_tokens: int = 16
     temperature: float = 0.0  # <= 0 -> greedy
     top_k: int = 0            # 0 -> full distribution
+    top_p: float = 1.0        # nucleus mass; >= 1 (or <= 0) -> disabled
     seed: int = 0
     deadline_s: float | None = None  # wall-clock budget from submit()
     start_index: int = 0      # tokens already delivered (failover resume)
@@ -165,9 +180,13 @@ class TokenStream:
 
 class _Request:
     __slots__ = (
-        "id", "prompt", "sampling", "out", "generated", "rng",
+        "id", "prompt", "sampling", "out", "generated",
         "reserved_blocks", "drawn_blocks", "prefill_done", "cached_tokens",
         "started", "skips", "table_np", "table_key", "done", "deadline",
+        # dispatch-ahead decode: dispatched-but-unreconciled device steps
+        # that include this row, and whether its KV blocks went back to
+        # the pool (exactly-once release under the lag)
+        "inflight", "blocks_released",
         # lifecycle observability (ISSUE 4): the phase timeline rides the
         # request, and a stored trace context turns it into spans on finish
         "trace_ctx", "timeline", "submitted_clock", "first_token_clock",
@@ -189,11 +208,11 @@ class _Request:
         self.finish_reason: str | None = None
         self.out: queue.Queue = queue.Queue()
         self.generated: list[int] = []
-        self.rng = np.random.default_rng(sampling.seed)
-        if sampling.start_index:
-            # one uniform per token (see _sample): skipping start_index
-            # draws resumes the stream exactly where the dead replica left it
-            self.rng.random(sampling.start_index)
+        # sampling is keyed by (seed, absolute position) on device — no
+        # RNG state to carry or fast-forward; start_index only offsets
+        # the stream's public token numbering on failover resume
+        self.inflight = 0
+        self.blocks_released = False
         self.reserved_blocks = 0
         # blocks this request has consumed from its reservation so far:
         # prefix-cache hits + appended blocks + copy-on-write copies. The
@@ -217,41 +236,23 @@ class _Request:
         return len(self.prompt) + len(self.generated)
 
 
-def _sample(logits: np.ndarray, sp: SamplingParams, rng) -> int:
-    """Host-side sampling from one row of f32 logits.
-
-    Consumes exactly ONE uniform per token on every path — so a request's
-    RNG position is a pure function of how many tokens it has produced.
-    Mid-stream failover relies on this: re-prefilling
-    ``prompt + generated`` on a fresh engine with
-    ``start_index=len(generated)`` reproduces the remaining tokens
-    byte-identically.
-
-    Greedy (temperature <= 0) and top_k == 1 take a fast path: the token
-    is the argmax, so the softmax/cumsum work is skipped entirely — but
-    the uniform is still burned to keep the RNG contract uniform across
-    sampling configs.
-    """
-    u = rng.random()
-    if sp.temperature <= 0.0 or sp.top_k == 1:
-        return int(np.argmax(logits))
-    l = logits.astype(np.float64) / sp.temperature
-    if sp.top_k > 0 and sp.top_k < l.shape[-1]:
-        kth = np.partition(l, -sp.top_k)[-sp.top_k]
-        l = np.where(l < kth, -np.inf, l)
-    l = l - l.max()
-    p = np.exp(l)
-    p /= p.sum()
-    return int(
-        min(np.searchsorted(np.cumsum(p), u, side="right"), l.shape[-1] - 1)
-    )
-
-
-def _host_logits(logits) -> np.ndarray:
+def _host_tokens(tokens) -> np.ndarray:
     """The ONE device->host sync point on the emit path: materialize a
-    step's logits as f32 numpy for host-side sampling. All other engine
+    step's sampled token ids as O(batch) int32 numpy. All other engine
     code must stay on-device (tests/test_sanitizers.py lints this)."""
-    return np.asarray(logits, np.float32)
+    return np.asarray(tokens, np.int32)
+
+
+@dataclass
+class _PendingDecode:
+    """One dispatched-but-unsynced decode step: the on-device sampled
+    tokens [B] int32 (row i belongs to ``batch[i]``; padding rows are
+    garbage) and the exact batch list it was dispatched over. The steady
+    state keeps exactly one of these in flight — step N+1 feeds from
+    ``tokens`` directly and the host syncs N's ids one step behind."""
+
+    tokens: Any          # jax [B] int32, still on device
+    batch: list          # the _Request rows of this dispatch, in order
 
 
 class LLMEngine:
@@ -344,6 +345,20 @@ class LLMEngine:
         # "prefill" | "decode" | None — drives prefill/decode alternation
         # and gives tests a step-order trace.
         self.last_step_kind: str | None = None
+        # ---- dispatch-ahead decode pipeline ----
+        # the one in-flight decode step (None when the lag is collapsed)
+        self._pending: _PendingDecode | None = None
+        # Reusable numpy scratch, keyed (name, shape): shapes come from
+        # the closed bucket ladders so the pool is bounded. Each key holds
+        # TWO buffers used alternately — jnp.asarray can alias host memory
+        # zero-copy on the CPU backend, so a buffer must not be mutated
+        # until the dispatch that consumed it has provably executed; with
+        # the lag-1 sync, the step before last has always synced by the
+        # time its buffer comes around again.
+        self._scratch: dict[tuple, list] = {}
+        self._sync_seconds_total = 0.0
+        self._sync_bytes_total = 0
+        self._last_sync: dict | None = None  # merged into flight records
         # last cache-stat values already exported to the monotonic counters
         self._exported = {"hit": 0, "evict": 0, "cow": 0, "prefill": 0}
         # ---- observability plane (ISSUE 4) ----
@@ -406,6 +421,8 @@ class LLMEngine:
         self._m_ttft = obs.ttft_histogram()
         self._m_tpot = obs.tpot_histogram()
         self._m_queue_wait = obs.queue_wait_histogram()
+        self._m_sync = obs.host_sync_histogram()
+        self._m_sync_bytes = obs.sync_bytes_counter()
         self._m_compile = obs.compile_counter()
         # count compile events by shape key as DecodeFns sees new
         # signatures (attribute hook — DecodeFns stays constructible bare)
@@ -525,7 +542,10 @@ class LLMEngine:
                     self._prefill_chunk_locked()
                     self.last_step_kind = "prefill"
                     return True
-                if self._running:
+                if self._running or self._pending is not None:
+                    # pending-but-nothing-running still needs a step: the
+                    # lagged tokens must be reconciled (and blocks freed)
+                    # even when every row has since finished or evicted
                     self._decode_locked()
                     self.last_step_kind = "decode"
                     return True
@@ -575,6 +595,11 @@ class LLMEngine:
                 "cow_blocks": cs.cow_copies,
                 "prefill_tokens_total": computed,
                 "prefix_hit_rate": hit / max(1, hit + computed),
+                "host_sync_seconds_total": round(
+                    self._sync_seconds_total, 6
+                ),
+                "host_sync_bytes_total": self._sync_bytes_total,
+                "decode_inflight": 1 if self._pending is not None else 0,
                 "failed": self._failed is not None,
             }
 
@@ -636,6 +661,7 @@ class LLMEngine:
                     self._finish_obs_locked(r, "shutdown")
                     r.out.put(err)
                     r.out.put(_DONE)
+            self._pending = None
             self.cache.release_all()
             self._waiting.clear()
             self._waiting_blocks = 0
@@ -664,6 +690,23 @@ class LLMEngine:
                 return r
         return None
 
+    def _release_blocks_locked(self, r: _Request) -> None:
+        """Return an admitted request's blocks (allocation + leftover
+        reservation) to the pool EXACTLY ONCE, respecting the dispatch
+        lag: while the row still has an in-flight speculative step
+        (``inflight > 0``) release is deferred to the reconcile that
+        retires it, and blocks freed while any other dispatch is in
+        flight are quarantined until the next sync proves the dispatch
+        executed (kv_cache.free/flush_quarantine)."""
+        if r.blocks_released or r.inflight > 0:
+            return
+        r.blocks_released = True
+        leftover = r.reserved_blocks - r.drawn_blocks
+        self.cache.free(r.id, quarantine=self._pending is not None)
+        if leftover > 0:
+            self.cache.release_reservation(leftover)
+        self._work.notify_all()  # freed blocks may unblock admissions
+
     def _evict_locked(self, r: _Request) -> None:
         """Remove a live request from the scheduler and return its blocks
         (allocation + leftover reservation for admitted; queued worst-case
@@ -673,10 +716,8 @@ class LLMEngine:
                 self._running.remove(r)
             else:
                 self._prefilling.remove(r)
-            leftover = r.reserved_blocks - r.drawn_blocks
-            self.cache.free(r.id)
-            if leftover > 0:
-                self.cache.release_reservation(leftover)
+            r.done = True  # before release: an inflight row defers it
+            self._release_blocks_locked(r)
         else:
             try:
                 self._waiting.remove(r)
@@ -887,21 +928,32 @@ class LLMEngine:
                 self._length_buckets,
             )
             nb = ctx // bs
-        tokens = np.zeros((B, S), np.int32)
-        lengths = np.ones((B,), np.int32)  # padding rows: length 1
-        starts = np.zeros((B,), np.int32)
-        tables = np.zeros((B, nb), np.int32)
+        tokens = self._scratch_buf("pf_tokens", (B, S), np.int32)
+        lengths = self._scratch_buf("pf_lengths", (B,), np.int32)
+        starts = self._scratch_buf("pf_starts", (B,), np.int32)
+        tables = self._scratch_buf("pf_tables", (B, nb), np.int32)
+        # reused buffers: stale padding rows/columns must be re-zeroed
+        # (a stale table row could point at blocks now owned by a LIVE
+        # sequence — padding writes must stay on the garbage block)
+        tokens[len(batch):] = 0
+        lengths[:] = 1  # padding rows: length 1
+        starts[len(batch):] = 0
+        tables[len(batch):] = 0
         for i, (r, n) in enumerate(zip(batch, ns)):
             tokens[i, :n] = r.prompt[r.prefill_done : r.prefill_done + n]
+            tokens[i, n:] = 0
             lengths[i] = n
             starts[i] = r.prefill_done
             tables[i] = self._table_for(r, nb)
-        logits, self.cache.k, self.cache.v = self.fns.prefill(
+        toks_dev, self.cache.k, self.cache.v = self.fns.prefill(
             self.params, self.cache.k, self.cache.v,
             jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(tables),
             start=None if legacy else jnp.asarray(starts),
+            sample=self._sample_args_locked(batch, B),
         )
-        host = _host_logits(logits)
+        # first tokens sync immediately (lag 0): TTFT must not wait for
+        # the next decode step, and only final-chunk rows emit anyway
+        host = self._sync_tokens_locked(toks_dev, lag=0)
         # dt covers the phase's real cost — COW copies, padding, the
         # jitted call and THE host sync. The same value feeds the latency
         # histogram, the flight record, event_stats, and the per-request
@@ -917,9 +969,9 @@ class LLMEngine:
                 self.cache.register_prefix(r.id, r.prompt, r.prefill_done)
             if r.prefill_done >= len(r.prompt):
                 self._prefilling.remove(r)
-                # the model returns last-VALID-token logits per row — for
-                # the final chunk that is the last prompt token
-                self._emit_locked(r, host[i])
+                # the model samples from last-VALID-token logits per row —
+                # for the final chunk that is the last prompt token
+                self._emit_token_locked(r, int(host[i]))
                 if not r.done:
                     self._running.append(r)
         self._m_util.set(self.cache.utilization)
@@ -932,53 +984,210 @@ class LLMEngine:
         )
 
     def _decode_locked(self) -> None:
+        """One pipelined decode iteration (the tentpole's dispatch-ahead
+        loop). Steady state — the eligible batch is exactly the batch of
+        the in-flight step — dispatches step N+1 feeding straight from
+        step N's on-device sampled-token array, THEN syncs step N's ids:
+        all the host-side work above the dispatch (bucketing, COW prep,
+        table/position packing) overlaps step N's device compute, and the
+        sync itself is near-free because step N already finished. Any
+        batch change (join, finish, eviction, a row hitting its token
+        budget) first collapses the lag: reconcile the pending step on
+        host state, rebuild the batch, and dispatch fresh from host
+        tokens."""
         import jax.numpy as jnp
 
         chaos.fire("engine.decode", batch=len(self._running))
         t0 = obs.clock()
         t0_wall = obs.wall()
         bs = self.cfg.block_size
-        batch = list(self._running)
+        pending = self._pending
+
+        def eligible() -> list[_Request]:
+            # budget counts the speculative in-flight token too — a row
+            # at max_new_tokens-1 with one token in flight must not be
+            # dispatched again (its last token arrives at reconcile)
+            return [
+                r for r in self._running
+                if len(r.generated) + r.inflight < r.sampling.max_new_tokens
+            ]
+
+        batch = eligible()
+        # list equality is element identity here: same _Request objects
+        # in the same order <=> nothing joined/finished/evicted
+        steady = pending is not None and batch == pending.batch
+        emitted = 0
+        if pending is not None and not steady:
+            emitted += self._reconcile_locked(pending)
+            pending = None
+            batch = eligible()
+        if not batch:
+            # pure drain step: the reconcile above retired the last
+            # in-flight tokens; record it so the flight ring shows the
+            # lag collapsing rather than a mystery gap
+            dt = obs.clock() - t0
+            self._m_util.set(self.cache.utilization)
+            self._sync_cache_counters_locked()
+            self._m_latency.observe(dt, tags={"kind": "decode"})
+            event_stats.record("llm.engine.step.decode", dt)
+            self._flight_record_locked(
+                "decode", t0_wall, dt, batch=0, tokens=emitted,
+            )
+            return
         pairs: list[tuple[int, int]] = []
         for r in batch:
-            appended = self.cache.ensure_capacity(r.id, r.total_len)
+            # effective length includes the in-flight token: its K/V row
+            # lands at position eff-1 during this dispatch
+            eff = r.total_len + r.inflight
+            appended = self.cache.ensure_capacity(r.id, eff)
             r.drawn_blocks += appended
-            cow = self.cache.prepare_write(r.id, r.total_len - 1, r.total_len)
+            cow = self.cache.prepare_write(r.id, eff - 1, eff)
             r.drawn_blocks += len(cow)
             pairs.extend(cow)
         self._apply_copies_locked(pairs)
         B = pad_to_bucket(len(batch), self._batch_buckets)
         ctx = pad_to_bucket(
-            max(r.total_len for r in batch), self._length_buckets
+            max(r.total_len + r.inflight for r in batch),
+            self._length_buckets,
         )
         nb = ctx // bs
-        tokens = np.zeros((B,), np.int32)
-        positions = np.zeros((B,), np.int32)
-        tables = np.zeros((B, nb), np.int32)
+        positions = self._scratch_buf("dec_positions", (B,), np.int32)
+        tables = self._scratch_buf("dec_tables", (B, nb), np.int32)
+        # reused buffers: re-zero padding rows (a stale table row could
+        # point at blocks now owned by a live sequence)
+        positions[len(batch):] = 0
+        tables[len(batch):] = 0
         for i, r in enumerate(batch):
-            tokens[i] = r.generated[-1] if r.generated else r.prompt[-1]
-            positions[i] = r.total_len - 1
+            positions[i] = r.total_len + r.inflight - 1
             tables[i] = self._table_for(r, nb)
-        logits, self.cache.k, self.cache.v = self.fns.decode(
+        if steady:
+            # feed step N+1 from step N's sampled ids without a host
+            # round-trip — THE datapath that makes the pipeline a win
+            tokens_dev = pending.tokens
+        else:
+            tokens = self._scratch_buf("dec_tokens", (B,), np.int32)
+            tokens[len(batch):] = 0
+            for i, r in enumerate(batch):
+                tokens[i] = r.generated[-1] if r.generated else r.prompt[-1]
+            tokens_dev = jnp.asarray(tokens)
+        next_dev, self.cache.k, self.cache.v = self.fns.decode(
             self.params, self.cache.k, self.cache.v,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+            tokens_dev, jnp.asarray(positions), jnp.asarray(tables),
+            sample=self._sample_args_locked(batch, B),
         )
-        host = _host_logits(logits)
+        for r in batch:
+            r.inflight += 1
+        self._pending = _PendingDecode(tokens=next_dev, batch=batch)
+        if steady:
+            # reconcile step N only after dispatching N+1 — the host work
+            # above ran while N was still executing on device
+            emitted += self._reconcile_locked(pending)
         dt = obs.clock() - t0
-        for i, r in enumerate(batch):
-            self._emit_locked(r, host[i])
-        self._running = [r for r in self._running if not r.done]
         self._m_util.set(self.cache.utilization)
         self._sync_cache_counters_locked()
         self._m_latency.observe(dt, tags={"kind": "decode"})
         event_stats.record("llm.engine.step.decode", dt)
         self._flight_record_locked(
             "decode", t0_wall, dt, batch=len(batch), bucket_b=B,
-            bucket_len=ctx, nb=nb, tokens=len(batch),
+            bucket_len=ctx, nb=nb, tokens=emitted,
         )
 
-    def _emit_locked(self, r: _Request, logits_row: np.ndarray) -> None:
-        tok = _sample(logits_row, r.sampling, r.rng)
+    def _reconcile_locked(self, pending: _PendingDecode) -> int:
+        """Collapse the dispatch lag for one in-flight decode step: sync
+        its sampled ids (THE O(batch) int32 transfer), flush the block
+        quarantine (a completed sync proves every earlier dispatch
+        executed, so blocks freed before this step's dispatch are safe to
+        reuse), then emit/retire per row. Rows that terminated after the
+        dispatch (EOS raced the lag, cancel, deadline, failover) drop
+        their speculative token here and release their blocks — exactly
+        once, via the inflight-guarded release. Returns tokens emitted."""
+        if self._pending is pending:
+            self._pending = None
+        toks = self._sync_tokens_locked(pending.tokens, lag=1)
+        self.cache.flush_quarantine()
+        emitted = 0
+        for i, r in enumerate(pending.batch):
+            r.inflight -= 1
+            if r.done:
+                # the <=1 wasted speculative row per finished request
+                self._release_blocks_locked(r)
+                continue
+            self._emit_token_locked(r, int(toks[i]))
+            emitted += 1
+        self._running = [r for r in self._running if not r.done]
+        return emitted
+
+    def _sync_tokens_locked(self, tokens_dev, *, lag: int) -> np.ndarray:
+        """THE device->host sync: O(batch) int32 token ids, timed and
+        metered. ``lag`` says how many dispatches sat between this
+        array's producing step and now (0 = prefill's immediate sync,
+        1 = the pipelined decode path); it lands in the flight record so
+        lagged token timestamps are explainable (docs/OBSERVABILITY.md)."""
+        t0 = obs.clock()
+        toks = _host_tokens(tokens_dev)
+        dt = obs.clock() - t0
+        assert toks.dtype == np.int32 and toks.ndim == 1, (
+            "sync path must move O(batch) int32, got "
+            f"{toks.dtype}/{toks.shape}"
+        )
+        self._m_sync.observe(dt)
+        self._m_sync_bytes.inc(toks.nbytes)
+        self._sync_seconds_total += dt
+        self._sync_bytes_total += toks.nbytes
+        self._last_sync = {
+            "sync_ms": round(dt * 1000.0, 3),
+            "sync_bytes": int(toks.nbytes),
+            "sync_lag": lag,
+        }
+        return toks
+
+    def _sample_args_locked(self, batch: list, B: int) -> dict:
+        """Per-row sampling controls as [B] device arrays — the ``sample``
+        pytree consumed by ops/sampling.py inside the jitted step.
+        Padding rows are greedy (temperature 0) so the batch-wide
+        all-greedy fast path stays available whenever every REAL row is
+        greedy."""
+        import jax.numpy as jnp
+
+        seeds = self._scratch_buf("sp_seeds", (B,), np.uint32)
+        temp = self._scratch_buf("sp_temp", (B,), np.float32)
+        top_k = self._scratch_buf("sp_top_k", (B,), np.int32)
+        top_p = self._scratch_buf("sp_top_p", (B,), np.float32)
+        n = len(batch)
+        seeds[n:] = 0
+        temp[n:] = 0.0
+        top_k[n:] = 0
+        top_p[n:] = 1.0
+        for i, r in enumerate(batch):
+            sp = r.sampling
+            seeds[i] = sp.seed & 0xFFFFFFFF
+            temp[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+        return {
+            "seeds": jnp.asarray(seeds),
+            "temperature": jnp.asarray(temp),
+            "top_k": jnp.asarray(top_k),
+            "top_p": jnp.asarray(top_p),
+        }
+
+    def _scratch_buf(self, name: str, shape: tuple, dtype) -> np.ndarray:
+        """Reusable numpy staging buffer for one (name, shape) slot. TWO
+        buffers alternate per slot: jnp.asarray may alias small host
+        arrays zero-copy, so a buffer must not be rewritten until the
+        dispatch consuming it has provably executed — under the lag-1
+        pipeline a slot comes around again only after the intervening
+        sync, which is exactly that proof. Callers must overwrite every
+        element they use and re-zero padding tails (buffers are dirty)."""
+        key = (name, shape)
+        slot = self._scratch.get(key)
+        if slot is None:
+            slot = [np.zeros(shape, dtype), np.zeros(shape, dtype), 0]
+            self._scratch[key] = slot
+        slot[2] ^= 1
+        return slot[slot[2]]
+
+    def _emit_token_locked(self, r: _Request, tok: int) -> None:
         r.generated.append(tok)
         now = obs.clock()
         if r.first_token_clock is None:
@@ -1000,14 +1209,12 @@ class LLMEngine:
             self._complete_locked(r)
 
     def _complete_locked(self, r: _Request) -> None:
-        leftover = r.reserved_blocks - r.drawn_blocks
-        self.cache.free(r.id)
-        if leftover > 0:
-            self.cache.release_reservation(leftover)
         r.done = True
         self._finish_obs_locked(r, "finished")
         r.out.put(_DONE)
-        self._work.notify_all()  # freed blocks may unblock admissions
+        # last: a row completing while its next token is still in flight
+        # defers the free to that step's reconcile (exactly-once release)
+        self._release_blocks_locked(r)
 
     def _sync_cache_counters_locked(self) -> None:
         """Export cache-stat deltas to the monotonic Prometheus counters
@@ -1140,6 +1347,10 @@ class LLMEngine:
             "running": len(self._running),
         }
         rec.update(fields)
+        if self._last_sync is not None:
+            # the step that PAID for a host sync carries its cost + lag
+            rec.update(self._last_sync)
+            self._last_sync = None
         self._flight_prev["cow"] = cs.cow_copies
         self._flight_prev["evict"] = cs.prefix_evicted_blocks
         self._flight.record(rec)
@@ -1218,6 +1429,7 @@ class LLMEngine:
         self._waiting_blocks = 0
         self._prefilling = []
         self._running = []
+        self._pending = None  # in-flight step dies with the engine
         self.cache.release_all()
 
     # ---------------- background stepping ----------------

@@ -110,6 +110,13 @@ class PagedKVCache:
         self.v = jnp.zeros(shape, dtype)
         # LIFO free list: a just-freed (cache-warm) block is reused first
         self._free: list[int] = list(range(1, cfg.num_blocks))
+        # Lag-aware release (dispatch-ahead decode): blocks freed while a
+        # device step is still in flight park here instead of the free
+        # list, so they cannot be handed to a new allocation until the
+        # engine's next token sync PROVES the in-flight step (and any
+        # speculative write it carries) has executed. flush_quarantine()
+        # moves them to the free list at that sync.
+        self._quarantine: list[int] = []
         self._tables: dict[Any, list[int]] = {}
         self._reserved = 0
         # prefix cache state
@@ -194,28 +201,49 @@ class PagedKVCache:
             )
         return appended
 
-    def _deref(self, b: int) -> None:
+    def _deref(self, b: int, *, quarantine: bool = False) -> None:
         self._ref[b] -= 1
         if self._ref[b] == 0:
             del self._ref[b]
             if b in self._block_hash:
-                # content survives, resurrectable until evicted
+                # content survives, resurrectable until evicted. Never
+                # quarantined: hashed blocks are full PROMPT blocks and
+                # speculative decode writes land past the prompt (COW'd
+                # onto private blocks by prepare_write), so no in-flight
+                # step can scribble on them.
                 self._lru[b] = None  # appended at the MRU end
+            elif quarantine:
+                self._quarantine.append(b)
             else:
                 self._free.append(b)
 
-    def free(self, seq_id) -> int:
+    def free(self, seq_id, *, quarantine: bool = False) -> int:
         """Drop a finished sequence's references; -> table length. Blocks
         it shared with live sequences stay put; sole-owned blocks return
         to the free list, except content-addressed ones, which park in the
-        LRU set (still resurrectable by a future prefix hit)."""
+        LRU set (still resurrectable by a future prefix hit).
+
+        ``quarantine=True`` (the engine's dispatch-ahead path): sole-owned
+        blocks park in the quarantine instead of the free list until
+        ``flush_quarantine`` — see the field comment in ``__init__``."""
         table = self._tables.pop(seq_id)
         self._chain.pop(seq_id, None)
         self._versions.pop(seq_id, None)
         for b in reversed(table):  # LIFO: newest block reused first
-            self._deref(b)
+            self._deref(b, quarantine=quarantine)
         self.stats.freed_total += len(table)
         return len(table)
+
+    def flush_quarantine(self) -> int:
+        """Return quarantined blocks to the free list; -> count. The
+        engine calls this right after a token sync: completing the sync
+        proves every previously-dispatched device step has executed, so
+        blocks freed before those dispatches are safe to reuse."""
+        n = len(self._quarantine)
+        if n:
+            self._free.extend(self._quarantine)
+            self._quarantine.clear()
+        return n
 
     def release_all(self) -> int:
         """Free every sequence, drop all reservations AND the whole prefix
@@ -225,6 +253,7 @@ class PagedKVCache:
         returned = 0
         for seq_id in list(self._tables):
             returned += self.free(seq_id)
+        self.flush_quarantine()
         self._free.extend(self._lru)
         self._lru.clear()
         self._hash_to_block.clear()
@@ -390,6 +419,7 @@ class PagedKVCache:
             "block_size": self.cfg.block_size,
             "used_blocks": self.used_blocks,
             "free_blocks": len(self._free),
+            "quarantined_blocks": len(self._quarantine),
             "cached_blocks": self.cached_blocks,
             "reserved_blocks": self._reserved,
             "live_sequences": len(self._tables),

@@ -11,7 +11,7 @@ Design constraints (ISSUE 4 / docs/OBSERVABILITY.md):
   calls outside this module, so the records can never disagree about
   what was measured.
 - **Zero device syncs.** Nothing here touches jax values; the engine's
-  single device->host sync point (``_host_logits``) is unchanged.
+  single device->host sync point (``_host_tokens``) is unchanged.
 - **O(1) per step.** The flight recorder is a ``deque(maxlen=N)`` ring:
   one dict append per step, old records drop off the far end. Dumping is
   a read-only snapshot, safe from the lock-free watchdog thread (a
@@ -45,6 +45,11 @@ TTFT_BUCKETS = (
 )
 TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
 QUEUE_WAIT_BUCKETS = (0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 20.0)
+# The single O(batch) device->host sync (engine._host_tokens): sub-ms on
+# the pipelined steady state, device-step-sized when the lag collapses.
+HOST_SYNC_BUCKETS = (
+    0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0,
+)
 
 
 def ttft_histogram() -> metrics.Histogram:
@@ -68,6 +73,22 @@ def queue_wait_histogram() -> metrics.Histogram:
         "llm_queue_wait_seconds",
         "Time a request waited for admission (submit -> admitted)",
         boundaries=QUEUE_WAIT_BUCKETS,
+    )
+
+
+def host_sync_histogram() -> metrics.Histogram:
+    return metrics.histogram(
+        "llm_host_sync_seconds",
+        "Time blocked in the engine's single device->host token sync",
+        boundaries=HOST_SYNC_BUCKETS,
+    )
+
+
+def sync_bytes_counter() -> metrics.Counter:
+    return metrics.counter(
+        "llm_sync_bytes",
+        "Bytes crossed device->host at the engine's token sync point "
+        "(O(batch) int32 per step under fused sampling)",
     )
 
 

@@ -266,32 +266,49 @@ def test_chunked_prefill_with_prefix_hits_starts_at_first_miss(jax_cpu):
 
 # ------------------------------------------------- (e) greedy fast path
 
-def test_sample_draws_exactly_one_uniform_on_every_path():
-    """The RNG position must be a pure function of tokens produced — on
-    the greedy/top_k==1 fast paths too — or failover resume
-    (start_index RNG fast-forward) breaks."""
-    from ray_tpu.serve.llm.engine import SamplingParams, _sample
+def test_sampling_is_stateless_per_position(jax_cpu):
+    """The on-device sampler must be a pure function of
+    (logits, seed, position) — no host RNG stream to fast-forward — so a
+    resuming replica reproduces token N without replaying 0..N-1. Also
+    pins the greedy/top-1 fast-path equivalences the engine relies on."""
+    import jax.numpy as jnp
 
-    logits = np.random.default_rng(3).normal(size=257).astype(np.float32)
-    for sp in (
-        SamplingParams(temperature=0.0),            # greedy fast path
-        SamplingParams(temperature=0.5, top_k=1),   # top-1 fast path
-        SamplingParams(temperature=0.7, top_k=4),   # full path
-        SamplingParams(temperature=1.1),            # full path, no top-k
+    from ray_tpu.ops.sampling import sample_tokens
+
+    logits = np.random.default_rng(3).normal(size=(1, 257)).astype(
+        np.float32
+    )
+    dev = jnp.asarray(logits)
+
+    def one(position, *, temperature, top_k=0, top_p=1.0, seed=11):
+        sample = {
+            "seeds": jnp.asarray([seed], jnp.uint32),
+            "temperature": jnp.asarray([temperature], jnp.float32),
+            "top_k": jnp.asarray([top_k], jnp.int32),
+            "top_p": jnp.asarray([top_p], jnp.float32),
+        }
+        return int(
+            sample_tokens(dev, jnp.asarray([position], jnp.int32), sample)[0]
+        )
+
+    for kw in (
+        dict(temperature=0.7, top_k=4),     # top-k path
+        dict(temperature=1.1),              # plain temperature path
+        dict(temperature=0.9, top_p=0.8),   # nucleus path
     ):
-        rng = np.random.default_rng(11)
-        for _ in range(5):
-            _sample(logits, sp, rng)
-        ref = np.random.default_rng(11)
-        ref.random(5)  # exactly five uniforms consumed
-        assert rng.random() == ref.random(), sp
+        # same (seed, position) -> same token, however often it is asked
+        # and regardless of what was sampled "before" (there is no before)
+        first = [one(p, **kw) for p in range(5)]
+        assert [one(p, **kw) for p in reversed(range(5))] == first[::-1], kw
+        # different seed decorrelates the stream
+        assert any(
+            one(p, seed=12, **kw) != t for p, t in enumerate(first)
+        ) or len(set(first)) == 1, kw
 
-    # fast path == slow path token for top_k=1
-    greedy = _sample(logits, SamplingParams(temperature=0.0),
-                     np.random.default_rng(0))
-    top1 = _sample(logits, SamplingParams(temperature=0.9, top_k=1),
-                   np.random.default_rng(0))
-    assert greedy == top1 == int(np.argmax(logits))
+    # greedy and top-1 fast paths match host argmax at every position
+    ref = int(np.argmax(logits[0]))
+    assert one(0, temperature=0.0) == ref
+    assert one(7, temperature=0.9, top_k=1) == ref
 
 
 @pytest.mark.timeout(300)
