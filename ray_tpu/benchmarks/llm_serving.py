@@ -26,6 +26,14 @@ Reported (one JSON line, merged into bench.py's aux results under
                               tokens / decode-step wall time
 - ``llm_decode_step_p50_ms``  median wall time of one steady decode
                               step (dispatch + lagged O(batch) sync)
+- ``llm_sharded_decode_tokens_per_sec`` / ``llm_sharded_decode_step_p50_ms``
+                              the same steady-decode phase on a tp/fsdp
+                              ShardedExecutor engine (serve/llm/
+                              executor.py) over virtual CPU devices —
+                              tracks the per-step overhead the executor
+                              seam + GSPMD partitioning add to the
+                              scheduler hot loop; ``llm_sharded_mesh``
+                              records the mesh shape measured
 
 Runs on CPU with the tiny llama config — the point is tracking the
 scheduler/cache overheads and the hit-rate plumbing release-over-release,
@@ -34,6 +42,7 @@ not absolute TPU throughput (bench.py GPT-MFU owns that axis).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 SHARED_PREFIX_TOKENS = 96
@@ -44,6 +53,19 @@ MAX_NEW_TOKENS = 8
 # long enough to dominate with steady decode steps, short enough to stay
 # inside the context bucket the warm waves already compiled (96+4+24 < 128)
 STEADY_NEW_TOKENS = 24
+SHARDED_DEVICES = 8   # virtual CPU devices for the sharded-decode phase
+
+
+def _ensure_virtual_devices(n: int) -> None:
+    """Expose n virtual CPU devices for the sharded phase. Must run
+    before the first JAX backend init in this process (main() calls it
+    first; a no-op when the flag is already set, e.g. under pytest's
+    conftest)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
 
 
 def run_serving_bench() -> dict:
@@ -193,8 +215,84 @@ def run_serving_bench() -> dict:
     }
 
 
+def run_sharded_decode_bench() -> dict:
+    """Steady-state decode on a ShardedExecutor engine: the MULTICHIP
+    serving number. Picks the widest tp/fsdp the visible devices and the
+    model's KV heads allow (tp must divide n_kv_head — the paged pool
+    shards along its head axis); degrades to None metrics when only one
+    device is usable so the report never lies about what it measured."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    mc = LlamaConfig.tiny()
+    n_dev = len(jax.devices())
+    n_kv = getattr(mc, "n_kv_head", mc.n_head)
+    tp = 2 if (n_dev >= 2 and n_kv % 2 == 0) else 1
+    fsdp = 2 if n_dev >= 2 * tp else 1
+    if tp * fsdp == 1:
+        return {
+            "llm_sharded_decode_tokens_per_sec": None,
+            "llm_sharded_decode_step_p50_ms": None,
+            "llm_sharded_mesh": None,
+        }
+    eng = LLMEngine(
+        EngineConfig(
+            model="llama",
+            model_config=mc,
+            block_size=8,
+            num_blocks=256,
+            max_batch_size=WAVE_REQUESTS,
+            max_prefill_batch=WAVE_REQUESTS,
+            tp=tp,
+            fsdp=fsdp,
+        ),
+        auto_step=False,
+    )
+    rng = np.random.default_rng(1)
+    streams = [
+        eng.submit(
+            [int(t) for t in rng.integers(1, mc.vocab_size, 12)],
+            max_new_tokens=STEADY_NEW_TOKENS,
+        )
+        for _ in range(WAVE_REQUESTS)
+    ]
+    step_s: list[float] = []
+    for _ in range(10_000):
+        if all(s.done for s in streams):
+            break
+        t0 = time.perf_counter()
+        if not eng.step():
+            break
+        dt = time.perf_counter() - t0
+        if eng.last_step_kind == "decode":
+            step_s.append(dt)
+    while eng.step():  # collapse the trailing in-flight step
+        pass
+    tokens = sum(len(list(s)) for s in streams)
+    # warmed measurement: drop the compile-bearing first steps (half the
+    # ladder of batch buckets compiles during ramp-up)
+    warm = step_s[len(step_s) // 4:] if len(step_s) >= 8 else step_s
+    eng.shutdown()
+    return {
+        "llm_sharded_decode_tokens_per_sec": round(
+            tokens / max(sum(step_s), 1e-9), 1
+        ),
+        "llm_sharded_decode_step_p50_ms": round(
+            float(np.percentile(warm, 50)) * 1e3, 3
+        )
+        if warm else None,
+        "llm_sharded_mesh": {"tp": tp, "fsdp": fsdp},
+    }
+
+
 def main() -> None:
-    print(json.dumps({"llm_serving": run_serving_bench()}), flush=True)
+    _ensure_virtual_devices(SHARDED_DEVICES)
+    out = run_serving_bench()
+    out.update(run_sharded_decode_bench())
+    print(json.dumps({"llm_serving": out}), flush=True)
 
 
 if __name__ == "__main__":

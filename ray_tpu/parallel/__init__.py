@@ -7,6 +7,7 @@ from ray_tpu.parallel.mesh import (
 from ray_tpu.parallel.sharding import (
     ShardingRules,
     logical_to_mesh_axes,
+    param_shardings,
     shard_batch_spec,
     shard_params,
     with_logical_constraint,
@@ -19,6 +20,7 @@ __all__ = [
     "local_mesh",
     "ShardingRules",
     "logical_to_mesh_axes",
+    "param_shardings",
     "shard_batch_spec",
     "shard_params",
     "with_logical_constraint",

@@ -51,20 +51,38 @@ class MeshSpec:
         }
 
     def resolve(self, n_devices: int) -> "MeshSpec":
+        if n_devices < 1:
+            raise ValueError(
+                f"cannot resolve a mesh over {n_devices} devices"
+            )
         sizes = self.sizes()
+        bad = {k: v for k, v in sizes.items() if v != -1 and v < 1}
+        if bad:
+            raise ValueError(
+                f"mesh axis sizes must be positive ints, or -1 on one "
+                f"axis to fill the remaining devices; got {bad}"
+            )
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
-            raise ValueError("at most one mesh axis may be -1")
+            raise ValueError(
+                f"at most one mesh axis may be -1, got {wild}"
+            )
         fixed = math.prod(v for v in sizes.values() if v != -1)
+        named = {k: v for k, v in sizes.items() if v != -1 and v > 1}
         if wild:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                    f"cannot fill mesh axis {wild[0]!r}: the fixed axes "
+                    f"{named or '{}'} multiply to {fixed}, which does "
+                    f"not divide the {n_devices} available devices"
                 )
             sizes[wild[0]] = n_devices // fixed
         elif fixed != n_devices:
             raise ValueError(
-                f"mesh axes product {fixed} != device count {n_devices}"
+                f"mesh axes {named or '{}'} multiply to {fixed} but "
+                f"{n_devices} devices are available; axis sizes must "
+                f"multiply to exactly the device count (use -1 on one "
+                f"axis to fill)"
             )
         return MeshSpec(
             dp=sizes[AxisNames.DATA],

@@ -54,6 +54,36 @@ class BatchConfig:
     size_buckets: tuple[int, ...] | None = None
 
 
+@dataclass(frozen=True)
+class ModelParallelConfig:
+    """Per-replica model-parallel layout for LLM serving
+    (serve/llm/executor.py ShardedExecutor).
+
+    ``tp`` shards attention/KV heads, MLP hidden, and the vocab
+    projection Megatron-style — including the paged KV pool, which
+    splits along its head axis (so ``n_kv_head % tp == 0`` is required);
+    ``fsdp`` shards the embed axis of every weight (ZeRO-3). One replica
+    occupies ``tp * fsdp`` chips; the default (1, 1) keeps the
+    single-device executor and changes nothing. Passed as the ``mesh``
+    field of ``EngineConfig`` (or via ``LLMDeployment`` /
+    ``build_llm_app`` plumbing).
+    """
+
+    tp: int = 1
+    fsdp: int = 1
+
+    def __post_init__(self):
+        if self.tp < 1 or self.fsdp < 1:
+            raise ValueError(
+                f"tp and fsdp must be >= 1, got tp={self.tp} "
+                f"fsdp={self.fsdp}"
+            )
+
+    @property
+    def n_devices(self) -> int:
+        return self.tp * self.fsdp
+
+
 @dataclass
 class DeploymentConfig:
     num_replicas: int = 1

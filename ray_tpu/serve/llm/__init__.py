@@ -8,6 +8,7 @@ closed bucket set so the XLA compile cache stays bounded (arxiv
 
 - kv_cache.py — block allocator + preallocated cache arrays + block tables
 - decode.py   — jitted prefill / single-token decode per model family
+- executor.py — ModelExecutor seam: single-device or tp/fsdp-sharded
 - engine.py   — the continuous-batching scheduler (admission, join/evict)
 - api.py      — LLMDeployment: the engine as a streaming Serve deployment
 
@@ -19,8 +20,15 @@ from ray_tpu.exceptions import (
     EngineOverloadedError,
     RequestCancelledError,
 )
+from ray_tpu.serve.config import ModelParallelConfig
 from ray_tpu.serve.llm.api import LLMDeployment, build_llm_app, stream_tokens
 from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.serve.llm.executor import (
+    ModelExecutor,
+    ShardedExecutor,
+    SingleDeviceExecutor,
+    build_executor,
+)
 from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
 
 __all__ = [
@@ -31,9 +39,14 @@ __all__ = [
     "KVCacheConfig",
     "LLMDeployment",
     "LLMEngine",
+    "ModelExecutor",
+    "ModelParallelConfig",
     "PagedKVCache",
     "RequestCancelledError",
     "SamplingParams",
-    "build_llm_app",
+    "ShardedExecutor",
+    "SingleDeviceExecutor",
+    "build_executor",
     "stream_tokens",
+    "build_llm_app",
 ]

@@ -59,11 +59,30 @@ def decode_token(token: int) -> str:
 @deployment(max_ongoing_requests=8)
 class LLMDeployment:
     """Streaming LLM deployment. Bind with an EngineConfig (or dict of its
-    fields): ``serve.run(LLMDeployment.bind(EngineConfig(...)))``."""
+    fields): ``serve.run(LLMDeployment.bind(EngineConfig(...)))``.
 
-    def __init__(self, engine_config: EngineConfig | dict | None = None):
+    Multi-chip replicas: pass ``mesh=`` (a ``ModelParallelConfig``, a
+    ``parallel.MeshSpec``, a built ``jax.sharding.Mesh``, or a dict of
+    axis sizes) — or set ``tp``/``fsdp`` on the EngineConfig itself — and
+    the replica's engine runs the tp/fsdp ShardedExecutor over that mesh
+    (docs/SERVING_LLM.md "Sharded serving"). Defaults stay single-device;
+    request payloads, streaming, failover, and the prefix cache are
+    identical either way — a stream started on a sharded replica resumes
+    byte-identically on a single-chip one and vice versa."""
+
+    def __init__(
+        self,
+        engine_config: EngineConfig | dict | None = None,
+        mesh: Any = None,
+    ):
         if isinstance(engine_config, dict):
             engine_config = EngineConfig(**engine_config)
+        if mesh is not None:
+            import dataclasses
+
+            engine_config = dataclasses.replace(
+                engine_config or EngineConfig(), mesh=mesh
+            )
         self.engine = LLMEngine(engine_config)
         # external request_id -> engine-internal id, for cancel()
         self._active: dict[str, Any] = {}
@@ -203,11 +222,27 @@ def stream_tokens(handle, payload: dict, *, max_failovers: int = 2):
 
 def build_llm_app(
     engine_config: EngineConfig | dict | None = None,
+    *,
+    mesh: Any = None,
+    tp: int = 1,
+    fsdp: int = 1,
     **deployment_options: Any,
 ) -> Application:
     """Convenience: ``serve.run(build_llm_app(EngineConfig(...)))``.
     ``deployment_options`` forward to ``.options(...)`` (num_replicas,
-    ray_actor_options for TPU chips, ...)."""
+    ray_actor_options for TPU chips, ...).
+
+    ``mesh``/``tp``/``fsdp`` select the per-replica model-parallel
+    layout (they override the EngineConfig fields of the same names);
+    the defaults keep every replica single-device."""
+    if mesh is not None or tp != 1 or fsdp != 1:
+        import dataclasses
+
+        if isinstance(engine_config, dict):
+            engine_config = EngineConfig(**engine_config)
+        engine_config = dataclasses.replace(
+            engine_config or EngineConfig(), mesh=mesh, tp=tp, fsdp=fsdp
+        )
     dep = LLMDeployment
     if deployment_options:
         dep = dep.options(**deployment_options)

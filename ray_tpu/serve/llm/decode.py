@@ -31,6 +31,25 @@ def _llama_fns(model_cfg):
 
 FAMILIES: dict[str, Callable] = {"gpt": _gpt_fns, "llama": _llama_fns}
 
+
+def family_param_axes(family: str, model_cfg):
+    """Logical-axis tree matching the family's init output — what a
+    sharded executor feeds parallel.sharding.shard_params. Kept next to
+    FAMILIES so adding a model family means extending exactly one
+    registry module."""
+    if family == "gpt":
+        from ray_tpu.models.gpt import gpt_param_axes
+
+        return gpt_param_axes(model_cfg)
+    if family == "llama":
+        from ray_tpu.models.llama import llama_param_axes
+
+        return llama_param_axes(model_cfg)
+    raise ValueError(
+        f"unknown model family {family!r}; expected one of "
+        f"{sorted(FAMILIES)}"
+    )
+
 # Process-wide jit cache: jax.jit memoizes traces per *wrapper*, so two
 # engines over the same (family, config) — e.g. several replicas colocated
 # in one worker, or a test suite constructing many engines — must share

@@ -55,6 +55,12 @@ arrive — at most one wasted speculative row per just-finished request —
 and KV blocks freed while a dispatch is in flight are quarantined until
 the next sync proves the dispatch executed (kv_cache.flush_quarantine).
 
+Everything device-side sits behind the ModelExecutor seam (executor.py):
+the scheduler stages numpy, the executor owns weights, the paged KV pool
+arrays, and the jitted calls. Single-device by default; EngineConfig
+``tp``/``fsdp``/``mesh`` select the tp/fsdp-sharded executor without any
+scheduler change (docs/SERVING_LLM.md "Sharded serving").
+
 Failure semantics (docs/SERVING_LLM.md "Failure semantics"):
 
 - ``submit`` applies admission control: a bounded waiting queue
@@ -96,7 +102,7 @@ from ray_tpu.exceptions import (
 )
 from ray_tpu.serve._shapes import pad_to_bucket, pow2_buckets
 from ray_tpu.serve.llm import obs
-from ray_tpu.serve.llm.decode import DecodeFns
+from ray_tpu.serve.llm.executor import build_executor
 from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
 from ray_tpu.util import metrics, tracing
 
@@ -151,6 +157,17 @@ class EngineConfig:
     flight_recorder_dir: str | None = None
     # Finished-request timelines kept for request_timeline() lookups.
     timeline_history: int = 256
+    # ---- multi-chip sharded serving (executor.py) ----
+    # Defaults are single-device (SingleDeviceExecutor — byte-for-byte
+    # the pre-seam engine). Widening tp/fsdp, or naming a mesh, selects
+    # ShardedExecutor: weights shard tp/fsdp with the training-side
+    # rules, the paged KV pool shards along its head axis over tp, and
+    # block tables/prefix cache/COW stay host-side.
+    # mesh: None | jax.sharding.Mesh | parallel.MeshSpec |
+    #       serve.config.ModelParallelConfig | dict of axis sizes.
+    mesh: Any = None
+    tp: int = 1      # tensor-parallel ways (heads/mlp/vocab + KV heads)
+    fsdp: int = 1    # fsdp ways (embed axis of every weight)
 
 
 class TokenStream:
@@ -236,13 +253,6 @@ class _Request:
         return len(self.prompt) + len(self.generated)
 
 
-def _host_tokens(tokens) -> np.ndarray:
-    """The ONE device->host sync point on the emit path: materialize a
-    step's sampled token ids as O(batch) int32 numpy. All other engine
-    code must stay on-device (tests/test_sanitizers.py lints this)."""
-    return np.asarray(tokens, np.int32)
-
-
 @dataclass
 class _PendingDecode:
     """One dispatched-but-unsynced decode step: the on-device sampled
@@ -272,8 +282,6 @@ class LLMEngine:
         auto_step: bool = True,
         **overrides,
     ):
-        import jax
-
         if cfg is None:
             cfg = EngineConfig(**overrides)
         elif overrides:
@@ -292,12 +300,6 @@ class LLMEngine:
                 model_cfg = LlamaConfig.tiny()
         self.cfg = cfg
         self.model_cfg = model_cfg
-        self.fns = DecodeFns(cfg.model, model_cfg)
-        self.params = (
-            params
-            if params is not None
-            else self.fns.init(jax.random.PRNGKey(cfg.seed), model_cfg)
-        )
         n_kv = getattr(model_cfg, "n_kv_head", model_cfg.n_head)
         self.cache = PagedKVCache(
             KVCacheConfig(
@@ -308,6 +310,13 @@ class LLMEngine:
                 block_size=cfg.block_size,
                 dtype=model_cfg.dtype,
             )
+        )
+        # the ModelExecutor seam (executor.py): the engine schedules on
+        # host state only; weights, the KV pool arrays, and the jitted
+        # step calls live behind the executor — single-device by
+        # default, tp/fsdp-sharded when the config names a mesh
+        self.executor = build_executor(
+            cfg, model_cfg, self.cache, params=params
         )
         self._batch_buckets = cfg.batch_buckets or pow2_buckets(
             1, cfg.max_batch_size
@@ -424,9 +433,15 @@ class LLMEngine:
         self._m_sync = obs.host_sync_histogram()
         self._m_sync_bytes = obs.sync_bytes_counter()
         self._m_compile = obs.compile_counter()
+        self._m_devices = metrics.gauge(
+            "llm_executor_devices",
+            "Devices driven by this engine's model executor",
+        )
+        self._m_devices.set(self.executor.num_devices)
         # count compile events by shape key as DecodeFns sees new
-        # signatures (attribute hook — DecodeFns stays constructible bare)
-        self.fns.on_new_signature = self._on_new_signature
+        # signatures (attribute hook, forwarded through the executor —
+        # DecodeFns stays constructible bare)
+        self.executor.on_new_signature = self._on_new_signature
 
     # ---------------- public API ----------------
 
@@ -600,8 +615,22 @@ class LLMEngine:
                 ),
                 "host_sync_bytes_total": self._sync_bytes_total,
                 "decode_inflight": 1 if self._pending is not None else 0,
+                "executor": self.executor.describe(),
                 "failed": self._failed is not None,
             }
+
+    @property
+    def fns(self):
+        """The executor's DecodeFns (compile-signature accounting) —
+        kept as an engine attribute for tests/dashboards that predate
+        the executor seam."""
+        return self.executor.fns
+
+    @property
+    def params(self):
+        """Model weights, wherever the executor placed them (one device,
+        or sharded over its mesh)."""
+        return self.executor.params
 
     @property
     def num_compiled_shapes(self) -> int:
@@ -632,6 +661,7 @@ class LLMEngine:
         with self._lock:
             return self._flight.dump("debug", extra={
                 "stats": self.stats(),
+                "executor": self.executor.describe(),
                 "cache": self.cache.debug_snapshot(),
                 "compiled_shapes": sorted(
                     obs.shape_key(s) for s in self.fns.signatures
@@ -863,25 +893,12 @@ class LLMEngine:
         return r.table_np
 
     def _apply_copies_locked(self, pairs: list[tuple[int, int]]) -> None:
-        """Clone shared blocks on device (COW) before a write lands. The
-        (src, dst) list pads to a pow2 bucket with (0, 0) — copying the
-        garbage block onto itself — so the jitted shape set stays
-        closed."""
+        """Clone shared blocks on device (COW) before a write lands —
+        pow2 pair-list padding and the fused on-device copy live in the
+        executor (executor.copy_blocks)."""
         if not pairs:
             return
-        import jax.numpy as jnp
-
-        from ray_tpu.ops.kv_cache import copy_blocks
-
-        width = 1 << (len(pairs) - 1).bit_length()
-        src = np.zeros((width,), np.int32)
-        dst = np.zeros((width,), np.int32)
-        for i, (s, d) in enumerate(pairs):
-            src[i] = s
-            dst[i] = d
-        self.cache.k, self.cache.v = copy_blocks(
-            self.cache.k, self.cache.v, jnp.asarray(src), jnp.asarray(dst)
-        )
+        self.executor.copy_blocks(pairs)
 
     def _prefill_chunk_locked(self) -> None:
         """Run ONE prefill call for up to ``max_prefill_batch`` admitted
@@ -890,8 +907,6 @@ class LLMEngine:
         take the monolithic reference path (start=None) — identical
         numerics and compile signatures to PR 1; anything mid-prompt or
         prefix-seeded takes the paged chunk path at true positions."""
-        import jax.numpy as jnp
-
         batch = self._prefilling[: self.cfg.max_prefill_batch]
         chaos.fire("engine.prefill", batch=len(batch))
         t0 = obs.clock()
@@ -945,12 +960,15 @@ class LLMEngine:
             lengths[i] = n
             starts[i] = r.prefill_done
             tables[i] = self._table_for(r, nb)
-        toks_dev, self.cache.k, self.cache.v = self.fns.prefill(
-            self.params, self.cache.k, self.cache.v,
-            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(tables),
-            start=None if legacy else jnp.asarray(starts),
-            sample=self._sample_args_locked(batch, B),
-        )
+        sample = self._sample_args_locked(batch, B)
+        if legacy:
+            toks_dev = self.executor.prefill(
+                tokens, lengths, tables, sample=sample
+            )
+        else:
+            toks_dev = self.executor.prefill_chunk(
+                tokens, lengths, starts, tables, sample=sample
+            )
         # first tokens sync immediately (lag 0): TTFT must not wait for
         # the next decode step, and only final-chunk rows emit anyway
         host = self._sync_tokens_locked(toks_dev, lag=0)
@@ -995,8 +1013,6 @@ class LLMEngine:
         budget) first collapses the lag: reconcile the pending step on
         host state, rebuild the batch, and dispatch fresh from host
         tokens."""
-        import jax.numpy as jnp
-
         chaos.fire("engine.decode", batch=len(self._running))
         t0 = obs.clock()
         t0_wall = obs.wall()
@@ -1063,16 +1079,16 @@ class LLMEngine:
         if steady:
             # feed step N+1 from step N's sampled ids without a host
             # round-trip — THE datapath that makes the pipeline a win
-            tokens_dev = pending.tokens
+            # (the executor passes on-device arrays through untouched)
+            tokens_src = pending.tokens
         else:
             tokens = self._scratch_buf("dec_tokens", (B,), np.int32)
             tokens[len(batch):] = 0
             for i, r in enumerate(batch):
                 tokens[i] = r.generated[-1] if r.generated else r.prompt[-1]
-            tokens_dev = jnp.asarray(tokens)
-        next_dev, self.cache.k, self.cache.v = self.fns.decode(
-            self.params, self.cache.k, self.cache.v,
-            tokens_dev, jnp.asarray(positions), jnp.asarray(tables),
+            tokens_src = tokens
+        next_dev = self.executor.decode_step(
+            tokens_src, positions, tables,
             sample=self._sample_args_locked(batch, B),
         )
         for r in batch:
@@ -1122,14 +1138,12 @@ class LLMEngine:
         metered. ``lag`` says how many dispatches sat between this
         array's producing step and now (0 = prefill's immediate sync,
         1 = the pipelined decode path); it lands in the flight record so
-        lagged token timestamps are explainable (docs/OBSERVABILITY.md)."""
+        lagged token timestamps are explainable (docs/OBSERVABILITY.md).
+        The transfer itself is the executor's ``sync_tokens``
+        (executor._host_tokens — THE allowed host sync)."""
         t0 = obs.clock()
-        toks = _host_tokens(tokens_dev)
+        toks = self.executor.sync_tokens(tokens_dev)
         dt = obs.clock() - t0
-        assert toks.dtype == np.int32 and toks.ndim == 1, (
-            "sync path must move O(batch) int32, got "
-            f"{toks.dtype}/{toks.shape}"
-        )
         self._m_sync.observe(dt)
         self._m_sync_bytes.inc(toks.nbytes)
         self._sync_seconds_total += dt
@@ -1142,13 +1156,11 @@ class LLMEngine:
         return toks
 
     def _sample_args_locked(self, batch: list, B: int) -> dict:
-        """Per-row sampling controls as [B] device arrays — the ``sample``
-        pytree consumed by ops/sampling.py inside the jitted step.
-        Padding rows are greedy (temperature 0) so the batch-wide
-        all-greedy fast path stays available whenever every REAL row is
-        greedy."""
-        import jax.numpy as jnp
-
+        """Per-row sampling controls as [B] host staging arrays — the
+        ``sample`` pytree consumed by ops/sampling.py inside the jitted
+        step (the executor moves the leaves on-device). Padding rows are
+        greedy (temperature 0) so the batch-wide all-greedy fast path
+        stays available whenever every REAL row is greedy."""
         seeds = self._scratch_buf("sp_seeds", (B,), np.uint32)
         temp = self._scratch_buf("sp_temp", (B,), np.float32)
         top_k = self._scratch_buf("sp_top_k", (B,), np.int32)
@@ -1165,10 +1177,10 @@ class LLMEngine:
             top_k[i] = sp.top_k
             top_p[i] = sp.top_p
         return {
-            "seeds": jnp.asarray(seeds),
-            "temperature": jnp.asarray(temp),
-            "top_k": jnp.asarray(top_k),
-            "top_p": jnp.asarray(top_p),
+            "seeds": seeds,
+            "temperature": temp,
+            "top_k": top_k,
+            "top_p": top_p,
         }
 
     def _scratch_buf(self, name: str, shape: tuple, dtype) -> np.ndarray:

@@ -1,0 +1,343 @@
+"""ModelExecutor — the seam between engine scheduling and model steps.
+
+The engine (engine.py) is a host-side scheduler: admission, block tables,
+bucketing, COW bookkeeping, timelines. Everything device-side — weights,
+the paged KV pool arrays, the jitted prefill/decode calls, the single
+token sync — lives behind the `ModelExecutor` interface in this module,
+so "how many chips run the model" is an executor choice the scheduler
+never sees. Two interchangeable implementations:
+
+- `SingleDeviceExecutor` — exactly the PR 1-5 behavior: one chip, plain
+  `jnp.asarray` staging, unsharded weights and KV pool. The default.
+- `ShardedExecutor` — a tp/fsdp mesh over several chips (ROADMAP item 1:
+  models larger than one chip's HBM). It builds a mesh from
+  `ray_tpu.parallel.mesh.MeshSpec`, shards the weights with the same
+  logical-axis rules training uses (parallel/sharding.py DEFAULT_RULES:
+  heads/mlp/vocab -> tp, embed -> fsdp), and shards the paged KV pool
+  along its HEAD axis over tp. Sharding propagates into the jitted steps
+  GSPMD-style from the committed inputs — the process-shared jit
+  wrappers in decode.py are reused as-is, so the compile-count contract
+  ((prefill, prefill_chunk, decode) x bucket shapes) is frozen exactly
+  as on one chip.
+
+What stays host-side under sharding — deliberately: block tables, the
+free list, prefix hashing, COW pair lists, and the quarantine are plain
+Python/numpy state in kv_cache.py; only `cache.k` / `cache.v` are device
+arrays, and only their placement changes. The engine's lag-1
+dispatch-ahead pipeline, keyed (seed, position) sampling, and the single
+O(batch) int32 `_host_tokens` sync point are executor-agnostic, so
+failover resume stays byte-identical on any mesh shape — a stream begun
+on a tp=2/fsdp=2 replica resumes bit-for-bit on a single-chip one.
+
+The sanitizer lint (tests/test_sanitizers.py) enforces the sync-point
+contract here exactly as it did in engine.py: `_host_tokens` below is
+the ONE place in serve/llm allowed to materialize a device value.
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.serve.llm.decode import DecodeFns, family_param_axes
+
+logger = logging.getLogger("ray_tpu.serve.llm")
+
+
+def _host_tokens(tokens) -> np.ndarray:
+    """The ONE device->host sync point on the emit path: materialize a
+    step's sampled token ids as O(batch) int32 numpy. All other serve/llm
+    code must stay on-device (tests/test_sanitizers.py lints this) —
+    for every executor, sharded included."""
+    return np.asarray(tokens, np.int32)
+
+
+class ModelExecutor:
+    """Device-side half of the LLM engine.
+
+    The engine stages every input as numpy (its bucketed scratch pool)
+    and calls one of the methods below; the executor owns placement:
+    where the weights live, how the paged KV pool arrays (`cache.k` /
+    `cache.v`) are laid out, and which devices the jitted step runs on.
+    Shared base implementation = the single-device datapath; subclasses
+    change placement in ``__init__``, never the call path — GSPMD infers
+    the sharded programs from the committed inputs, which is what keeps
+    the compile-signature set identical across executors.
+
+    Interface consumed by engine.py:
+
+    - ``prefill(tokens, lengths, tables, sample=)`` — monolithic
+      whole-prompt prefill; returns on-device [B] sampled token ids and
+      updates ``cache.k``/``cache.v`` in place.
+    - ``prefill_chunk(tokens, lengths, starts, tables, sample=)`` — the
+      chunked/prefix path at true positions.
+    - ``decode_step(tokens, positions, tables, sample=)`` — one decode
+      step; ``tokens`` is either a host staging array (cold dispatch) or
+      the previous step's on-device array (the lag-1 steady feed).
+    - ``copy_blocks(pairs)`` — fused on-device COW block copies.
+    - ``sync_tokens(tokens_dev)`` — THE O(batch) int32 host sync.
+    - ``on_new_signature`` — compile-event hook, forwarded to DecodeFns.
+    """
+
+    kind = "single"
+
+    def __init__(self, family: str, model_cfg, cache, *,
+                 params: dict | None = None, seed: int = 0):
+        import jax
+
+        self.family = family
+        self.model_cfg = model_cfg
+        self.cache = cache
+        self.fns = DecodeFns(family, model_cfg)
+        self.params = (
+            params
+            if params is not None
+            else self.fns.init(jax.random.PRNGKey(seed), model_cfg)
+        )
+
+    # ---------------- compile-event hooks (DecodeFns pass-through) ----
+
+    @property
+    def on_new_signature(self):
+        return self.fns.on_new_signature
+
+    @on_new_signature.setter
+    def on_new_signature(self, hook) -> None:
+        self.fns.on_new_signature = hook
+
+    @property
+    def num_compiled_shapes(self) -> int:
+        return self.fns.num_compiled_shapes
+
+    @property
+    def signatures(self) -> frozenset:
+        return self.fns.signatures
+
+    # ---------------- staging ----------------
+
+    def _dev(self, x):
+        """Host staging array -> device. On-device arrays (the lag-1
+        token feed) pass through untouched. Uncommitted placement: jit
+        moves the value to wherever the executable's sharding wants it,
+        so the SAME code serves one chip and a mesh."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(x)
+
+    def _dev_sample(self, sample: dict | None):
+        if sample is None:
+            return None
+        return {k: self._dev(v) for k, v in sample.items()}
+
+    # ---------------- the step interface ----------------
+
+    def prefill(self, tokens, lengths, tables, sample=None):
+        toks, self.cache.k, self.cache.v = self.fns.prefill(
+            self.params, self.cache.k, self.cache.v,
+            self._dev(tokens), self._dev(lengths), self._dev(tables),
+            sample=self._dev_sample(sample),
+        )
+        return toks
+
+    def prefill_chunk(self, tokens, lengths, starts, tables, sample=None):
+        toks, self.cache.k, self.cache.v = self.fns.prefill(
+            self.params, self.cache.k, self.cache.v,
+            self._dev(tokens), self._dev(lengths), self._dev(tables),
+            start=self._dev(starts), sample=self._dev_sample(sample),
+        )
+        return toks
+
+    def decode_step(self, tokens, positions, tables, sample=None):
+        toks, self.cache.k, self.cache.v = self.fns.decode(
+            self.params, self.cache.k, self.cache.v,
+            self._dev(tokens), self._dev(positions), self._dev(tables),
+            sample=self._dev_sample(sample),
+        )
+        return toks
+
+    def copy_blocks(self, pairs: list[tuple[int, int]]) -> None:
+        """Clone shared KV blocks on device (COW) before a write lands.
+        The (src, dst) list pads to a pow2 bucket with (0, 0) — copying
+        the garbage block onto itself — so the jitted shape set stays
+        closed. Runs sharded for free: the pool arrays carry their mesh
+        sharding and block indices are head-axis-invariant."""
+        if not pairs:
+            return
+        from ray_tpu.ops.kv_cache import copy_blocks
+
+        width = 1 << (len(pairs) - 1).bit_length()
+        src = np.zeros((width,), np.int32)
+        dst = np.zeros((width,), np.int32)
+        for i, (s, d) in enumerate(pairs):
+            src[i] = s
+            dst[i] = d
+        self.cache.k, self.cache.v = copy_blocks(
+            self.cache.k, self.cache.v, self._dev(src), self._dev(dst)
+        )
+
+    def sync_tokens(self, tokens_dev) -> np.ndarray:
+        """THE device->host transfer: one step's sampled ids as [B] int32
+        numpy. On a mesh the ids are replicated (every shard computes the
+        full vocab argmax/pick after the logits all-reduce), so the
+        transfer is the same O(batch) int32 regardless of device count."""
+        toks = _host_tokens(tokens_dev)
+        assert toks.dtype == np.int32 and toks.ndim == 1, (
+            "sync path must move O(batch) int32, got "
+            f"{toks.dtype}/{toks.shape}"
+        )
+        return toks
+
+    # ---------------- introspection ----------------
+
+    @property
+    def num_devices(self) -> int:
+        return 1
+
+    def describe(self) -> dict:
+        """Stable summary for stats()/debug_dump()/benchmarks: which
+        executor is serving and over how many devices."""
+        return {"executor": self.kind, "devices": self.num_devices,
+                "mesh": None}
+
+
+class SingleDeviceExecutor(ModelExecutor):
+    """Exactly the single-chip engine of PRs 1-5: default-device weights
+    and KV pool, including the lag-1 dispatch-ahead pipeline feed and
+    fused sampling (both of which live in the shared call path above)."""
+
+    kind = "single"
+
+
+def _resolve_mesh(mesh, tp: int, fsdp: int):
+    """Normalize the EngineConfig mesh surface to a jax Mesh.
+
+    Accepts a built ``jax.sharding.Mesh``, a ``parallel.MeshSpec``, a
+    ``serve.config.ModelParallelConfig`` (anything with tp/fsdp ints), a
+    dict of MeshSpec axis sizes, or None + (tp, fsdp) ints. A spec with
+    no wildcard may use FEWER devices than are visible — the mesh takes
+    the first tp*fsdp — so differently-shaped replicas can coexist on
+    one host (and in tests, on one virtual-device process)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    if isinstance(mesh, Mesh):
+        return mesh
+    if mesh is None:
+        spec = MeshSpec(tp=tp, fsdp=fsdp)
+    elif isinstance(mesh, MeshSpec):
+        spec = mesh
+    elif isinstance(mesh, dict):
+        spec = MeshSpec(**mesh)
+    elif hasattr(mesh, "tp") and hasattr(mesh, "fsdp"):
+        spec = MeshSpec(tp=int(mesh.tp), fsdp=int(mesh.fsdp))
+    else:
+        raise TypeError(
+            "mesh must be a jax.sharding.Mesh, MeshSpec, "
+            "ModelParallelConfig, dict of axis sizes, or None; got "
+            f"{type(mesh).__name__}"
+        )
+    devices = jax.devices()
+    sizes = spec.sizes()
+    if all(v != -1 for v in sizes.values()):
+        n = math.prod(sizes.values())
+        if n > len(devices):
+            raise ValueError(
+                f"mesh {({k: v for k, v in sizes.items() if v > 1})} "
+                f"needs {n} devices but only {len(devices)} are visible"
+            )
+        devices = devices[:n]
+    return build_mesh(spec, devices)
+
+
+class ShardedExecutor(ModelExecutor):
+    """tp/fsdp execution over a device mesh.
+
+    Placement (all decided here, in ``__init__``):
+
+    - weights: `parallel.sharding.shard_params` with the family's
+      logical-axis tree (models/{gpt,llama}.py ``*_param_axes``) under
+      DEFAULT_RULES — heads/mlp/vocab shard over tp (Megatron), embed
+      over fsdp (ZeRO-3); exactly the layout the training side proves.
+    - paged KV pool: ``cache.k``/``cache.v``
+      ([layer, block, slot, kv_head, head_dim]) shard along the KV-HEAD
+      axis over tp and replicate over fsdp. Block granularity, tables,
+      prefix hashes, COW and quarantine bookkeeping stay host-side in
+      kv_cache.py, byte-for-byte the single-chip code.
+
+    The step functions themselves are the process-shared jit wrappers
+    from decode.py: sharding flows from the committed params/pool inputs
+    (GSPMD), so no pjit re-wrap, no new compile kinds, and the engine's
+    signature accounting is unchanged. Requires ``n_kv_head % tp == 0``
+    (the pool's head axis must split evenly) and a tp/fsdp-only mesh —
+    dp/sp/pp/ep serving is future roadmap, not silently wrong."""
+
+    kind = "sharded"
+
+    def __init__(self, family: str, model_cfg, cache, *,
+                 mesh=None, tp: int = 1, fsdp: int = 1,
+                 params: dict | None = None, seed: int = 0):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ray_tpu.parallel import AxisNames
+        from ray_tpu.parallel.sharding import ShardingRules, shard_params
+
+        self.mesh = _resolve_mesh(mesh, tp, fsdp)
+        for axis in (AxisNames.DATA, AxisNames.PIPE, AxisNames.SEQ,
+                     AxisNames.EXPERT):
+            if self.mesh.shape[axis] != 1:
+                raise ValueError(
+                    "the serving executor shards tp/fsdp only; mesh axis "
+                    f"{axis!r} has size {self.mesh.shape[axis]} (batch is "
+                    "scheduled host-side, not dp-sharded)"
+                )
+        tp_size = self.mesh.shape[AxisNames.TENSOR]
+        n_kv = getattr(model_cfg, "n_kv_head", model_cfg.n_head)
+        if n_kv % tp_size != 0:
+            raise ValueError(
+                f"tp={tp_size} cannot shard the paged KV pool: the pool "
+                f"splits along its head axis and n_kv_head={n_kv} is not "
+                f"divisible by tp (choose tp from the divisors of "
+                f"{n_kv})"
+            )
+        super().__init__(family, model_cfg, cache, params=params, seed=seed)
+        self.rules = ShardingRules()
+        self.params = shard_params(
+            self.params, family_param_axes(family, model_cfg),
+            self.mesh, self.rules,
+        )
+        kv_spec = PartitionSpec(None, None, None, AxisNames.TENSOR)
+        cache.k = jax.device_put(cache.k, NamedSharding(self.mesh, kv_spec))
+        cache.v = jax.device_put(cache.v, NamedSharding(self.mesh, kv_spec))
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def describe(self) -> dict:
+        return {
+            "executor": self.kind,
+            "devices": self.num_devices,
+            # only the non-trivial axes — {"tp": 2, "fsdp": 2} reads as
+            # the operator-facing mesh shape
+            "mesh": {a: int(s) for a, s in self.mesh.shape.items()
+                     if int(s) > 1},
+        }
+
+
+def build_executor(cfg, model_cfg, cache, *, params=None) -> ModelExecutor:
+    """EngineConfig -> executor. Single-device unless the config names a
+    mesh (``mesh=``) or widens an axis (``tp``/``fsdp`` > 1) — the
+    default path constructs byte-for-byte the pre-seam engine."""
+    if cfg.mesh is None and cfg.tp == 1 and cfg.fsdp == 1:
+        return SingleDeviceExecutor(
+            cfg.model, model_cfg, cache, params=params, seed=cfg.seed
+        )
+    return ShardedExecutor(
+        cfg.model, model_cfg, cache, mesh=cfg.mesh, tp=cfg.tp,
+        fsdp=cfg.fsdp, params=params, seed=cfg.seed,
+    )

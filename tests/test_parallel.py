@@ -14,6 +14,26 @@ def test_mesh_spec_resolve():
         MeshSpec(dp=-1, tp=-1).resolve(8)
 
 
+def test_mesh_spec_resolve_rejects_bad_axis_sizes():
+    """Hardened error surface: zero/negative sizes (other than the -1
+    wildcard) and an unresolvable fill both raise ValueErrors that name
+    the offending axes — not a ZeroDivisionError from the fill math."""
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    with pytest.raises(ValueError, match="positive ints.*'tp': 0"):
+        MeshSpec(tp=0).resolve(8)
+    with pytest.raises(ValueError, match="positive ints"):
+        MeshSpec(dp=-1, tp=0).resolve(8)  # used to ZeroDivisionError
+    with pytest.raises(ValueError, match="positive ints"):
+        MeshSpec(fsdp=-2).resolve(8)
+    with pytest.raises(ValueError, match="cannot resolve"):
+        MeshSpec(dp=-1).resolve(0)
+    with pytest.raises(ValueError, match="does not divide"):
+        MeshSpec(dp=-1, tp=3).resolve(8)
+    with pytest.raises(ValueError, match="use -1 on one"):
+        MeshSpec(tp=2).resolve(8)
+
+
 def test_build_mesh(jax_cpu):
     from ray_tpu.parallel import MeshSpec, build_mesh
 

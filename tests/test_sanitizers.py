@@ -123,22 +123,30 @@ def test_no_bare_except_in_serving_path():
 
 
 def test_device_values_cross_host_only_in_host_tokens():
-    """Serving-perf lint (ISSUE 3/5): the engine's device->host traffic is
-    ONE O(batch) int32 token sync per step, in ``_host_tokens``
-    (engine.py). Any other ``np.asarray``/``np.array``/``.item()``/
-    ``device_get`` in serve/llm is a hidden device sync (or a smuggled
-    O(vocab) transfer) in the scheduler hot loop, and under the
-    dispatch-ahead pipeline a stray sync also collapses the lag.
-    Allowlist: ``_host_tokens`` (THE sync point) and kv_cache's
-    ``_block_key`` (hashes host-side Python int lists — never touches a
-    device value)."""
+    """Serving-perf lint (ISSUE 3/5/6): the engine's device->host traffic
+    is ONE O(batch) int32 token sync per step, in ``_host_tokens``
+    (executor.py — enforced for BOTH executors, single-device and
+    sharded; the engine goes through ``executor.sync_tokens``). Any other
+    ``np.asarray``/``np.array``/``.item()``/``device_get`` in serve/llm
+    is a hidden device sync (or a smuggled O(vocab) transfer) in the
+    scheduler hot loop, and under the dispatch-ahead pipeline a stray
+    sync also collapses the lag — under a sharded executor it would
+    additionally serialize every chip in the mesh. Allowlist:
+    ``_host_tokens`` (THE sync point) and kv_cache's ``_block_key``
+    (hashes host-side Python int lists — never touches a device
+    value)."""
     import ast
     import pathlib
 
     root = pathlib.Path(__file__).resolve().parents[1]
     targets = sorted((root / "ray_tpu" / "serve" / "llm").rglob("*.py"))
     assert targets, "serving path sources not found"
-    allowed = {("engine.py", "_host_tokens"), ("kv_cache.py", "_block_key")}
+    # executor.py (the single/sharded executor seam) must be among the
+    # lint targets — it owns the device<->host boundary now
+    assert any(p.name == "executor.py" for p in targets), (
+        "executor.py missing from serve/llm lint targets"
+    )
+    allowed = {("executor.py", "_host_tokens"), ("kv_cache.py", "_block_key")}
 
     offenders = []
     for path in targets:
@@ -179,7 +187,7 @@ def test_device_values_cross_host_only_in_host_tokens():
                 continue
             offenders.append(f"{path.relative_to(root)}:{node.lineno} ({fn})")
     assert not offenders, (
-        f"device->host sync outside engine._host_tokens: {offenders}"
+        f"device->host sync outside executor._host_tokens: {offenders}"
     )
 
 
