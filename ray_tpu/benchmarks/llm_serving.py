@@ -34,6 +34,15 @@ Reported (one JSON line, merged into bench.py's aux results under
                               seam + GSPMD partitioning add to the
                               scheduler hot loop; ``llm_sharded_mesh``
                               records the mesh shape measured
+- ``llm_paged_attn_xla_ms`` / ``llm_paged_attn_pallas_ms``
+                              decode attention in isolation: one jitted
+                              ``decode_attention`` call per backend
+                              (ops/paged_attention.py) at the fixed
+                              ``llm_paged_attn_shape``, median wall ms —
+                              tracks the kernel against the XLA
+                              formulation release-over-release (on CPU
+                              the Pallas number is interpret-mode, so it
+                              bounds correctness cost, not TPU perf)
 
 Runs on CPU with the tiny llama config — the point is tracking the
 scheduler/cache overheads and the hit-rate plumbing release-over-release,
@@ -54,6 +63,12 @@ MAX_NEW_TOKENS = 8
 # inside the context bucket the warm waves already compiled (96+4+24 < 128)
 STEADY_NEW_TOKENS = 24
 SHARDED_DEVICES = 8   # virtual CPU devices for the sharded-decode phase
+# decode-attention microbench: fixed [B, Hq, Hkv, hd] decode shape over a
+# bs x NB paged pool (T = 128 cached tokens of capacity per sequence)
+PAGED_ATTN_SHAPE = (8, 4, 2, 64)
+PAGED_ATTN_BLOCK = 16
+PAGED_ATTN_NBLOCKS = 8
+PAGED_ATTN_ITERS = 20
 
 
 def _ensure_virtual_devices(n: int) -> None:
@@ -288,10 +303,68 @@ def run_sharded_decode_bench() -> dict:
     }
 
 
+def run_paged_attn_microbench() -> dict:
+    """Decode attention isolated from the engine: one jitted
+    ``decode_attention`` per backend at a fixed decode shape, median wall
+    ms over ``PAGED_ATTN_ITERS`` calls. Shuffled block tables + ragged
+    positions so both paths pay realistic gather/walk patterns. The two
+    backends share inputs; a byte-comparison here would be redundant with
+    tests/test_paged_attention.py — this phase only times."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.paged_attention import decode_attention
+
+    B, Hq, Hkv, hd = PAGED_ATTN_SHAPE
+    bs, NB = PAGED_ATTN_BLOCK, PAGED_ATTN_NBLOCKS
+    key = jax.random.PRNGKey(42)
+    rng = np.random.default_rng(42)
+    num_blocks = 1 + B * NB
+    k_layer = jax.random.normal(
+        jax.random.fold_in(key, 0), (num_blocks, bs, Hkv, hd), jnp.float32
+    )
+    v_layer = jax.random.normal(
+        jax.random.fold_in(key, 1), (num_blocks, bs, Hkv, hd), jnp.float32
+    )
+    q = jax.random.normal(jax.random.fold_in(key, 2), (B, Hq, hd), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, num_blocks)).reshape(B, NB), jnp.int32
+    )
+    positions = jnp.asarray(
+        rng.integers(0, bs * NB, size=B), jnp.int32
+    )
+
+    out: dict = {
+        "llm_paged_attn_shape": {
+            "B": B, "Hq": Hq, "Hkv": Hkv, "hd": hd,
+            "block_size": bs, "T": bs * NB,
+        }
+    }
+    for backend in ("xla", "pallas"):
+        fn = jax.jit(
+            lambda q, k, v, t, p, _b=backend: decode_attention(
+                q, k, v, t, p, backend=_b
+            )
+        )
+        fn(q, k_layer, v_layer, tables, positions).block_until_ready()  # compile
+        samples = []
+        for _ in range(PAGED_ATTN_ITERS):
+            t0 = time.perf_counter()
+            fn(q, k_layer, v_layer, tables, positions).block_until_ready()
+            samples.append(time.perf_counter() - t0)
+        out[f"llm_paged_attn_{backend}_ms"] = round(
+            float(np.percentile(samples, 50)) * 1e3, 3
+        )
+    return out
+
+
 def main() -> None:
     _ensure_virtual_devices(SHARDED_DEVICES)
     out = run_serving_bench()
     out.update(run_sharded_decode_bench())
+    out.update(run_paged_attn_microbench())
     print(json.dumps({"llm_serving": out}), flush=True)
 
 

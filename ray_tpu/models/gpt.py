@@ -33,7 +33,12 @@ class GPTConfig:
     d_mlp: int = 3072
     dropout: float = 0.0  # dropout-free by default (modern practice)
     dtype: Any = jnp.bfloat16
-    attention: str = "flash"  # flash | xla | ring
+    attention: str = "flash"  # flash | xla | ring (training/full-seq path)
+    # decode attention backend (serve/llm): auto | xla | pallas — "auto"
+    # picks the Pallas paged-attention kernel (ops/paged_attention.py) on
+    # TPU and the XLA gather formulation elsewhere. Static in the jitted
+    # decode step; threaded from EngineConfig.attention_backend.
+    attention_backend: str = "auto"
     remat: bool = False       # jax.checkpoint each block (long-context)
     scan_layers: bool = True  # lax.scan over blocks (one compiled body) vs a
                               # fully unrolled Python loop. Unrolling lets XLA
@@ -420,7 +425,8 @@ def gpt_decode_step(
     ``sample`` pytree the logits never leave the device — returns
     (sampled tokens [B] int32, cache_k', cache_v').
     """
-    from ray_tpu.ops.kv_cache import paged_attention, write_kv
+    from ray_tpu.ops.kv_cache import write_kv
+    from ray_tpu.ops.paged_attention import decode_attention
 
     B = tokens.shape[0]
     D = cfg.d_model
@@ -435,8 +441,9 @@ def gpt_decode_step(
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk[:, 0], vv[:, 0], positions, block_tables
         )
-        attn = paged_attention(
-            q[:, 0], k_layer, v_layer, block_tables, positions
+        attn = decode_attention(
+            q[:, 0], k_layer, v_layer, block_tables, positions,
+            backend=cfg.attention_backend,
         )
         x = _attn_residual(x, attn.reshape(B, 1, D), bp, cfg)
         x = _mlp_residual(x, bp, cfg)

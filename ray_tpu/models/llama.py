@@ -37,7 +37,10 @@ class LlamaConfig:
     d_mlp: int = 1408  # ~8/3 * d_model rounded to 128 (SwiGLU sizing)
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
-    attention: str = "flash"  # flash | xla | ring
+    attention: str = "flash"  # flash | xla | ring (training/full-seq path)
+    # decode attention backend (serve/llm): auto | xla | pallas — see
+    # models/gpt.py GPTConfig.attention_backend.
+    attention_backend: str = "auto"
     remat: bool = False
     scan_layers: bool = True  # lax.scan over blocks vs unrolled loop (see
                               # models/gpt.py: unrolling dodges the
@@ -460,7 +463,8 @@ def llama_decode_step(
     Returns (next-token logits [B, V] f32, cache_k', cache_v'); with a
     ``sample`` pytree the logits never leave the device — returns
     (sampled tokens [B] int32, cache_k', cache_v')."""
-    from ray_tpu.ops.kv_cache import paged_attention, write_kv
+    from ray_tpu.ops.kv_cache import write_kv
+    from ray_tpu.ops.paged_attention import decode_attention
 
     B = tokens.shape[0]
     D = cfg.d_model
@@ -474,8 +478,9 @@ def llama_decode_step(
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk[:, 0], vv[:, 0], positions, block_tables
         )
-        attn = paged_attention(
-            q[:, 0], k_layer, v_layer, block_tables, positions
+        attn = decode_attention(
+            q[:, 0], k_layer, v_layer, block_tables, positions,
+            backend=cfg.attention_backend,
         )  # GQA handled inside (cache holds n_kv_head heads)
         x = x + attn.reshape(B, 1, D) @ bp["wo"].astype(cfg.dtype)
         x, _ = _ffn_residual(x, bp, cfg)

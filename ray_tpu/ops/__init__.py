@@ -1,5 +1,14 @@
 from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.kv_cache import (
+    copy_blocks,
+    gather_kv,
+    paged_attention,
+    paged_prefill_attention,
+    physical_slots,
+    write_kv,
+)
 from ray_tpu.ops.layers import gelu, layer_norm, rms_norm, rope, rope_cache
+from ray_tpu.ops.paged_attention import decode_attention, paged_attention_pallas
 
 __all__ = [
     "flash_attention",
@@ -9,4 +18,14 @@ __all__ = [
     "rms_norm",
     "rope",
     "rope_cache",
+    # paged-KV primitives (kv_cache.py)
+    "write_kv",
+    "gather_kv",
+    "copy_blocks",
+    "physical_slots",
+    "paged_attention",
+    "paged_prefill_attention",
+    # fused decode kernel + backend dispatcher (paged_attention.py)
+    "paged_attention_pallas",
+    "decode_attention",
 ]

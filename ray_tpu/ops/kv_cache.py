@@ -16,10 +16,13 @@ allocator, free lists, reuse) lives in serve/llm/kv_cache.py; these
 functions are pure array ops so the model decode paths (models/gpt.py,
 models/llama.py) can use them without depending on the serve layer.
 
-Attention here is the XLA formulation (gather blocks, mask, softmax) — the
-decode op is bandwidth-bound at [B, T] scale where a Pallas kernel has
-nothing to fuse away on CPU; a block-parallel TPU kernel is a later
-optimization with the same call signature.
+Attention here is the XLA formulation (gather blocks, mask, softmax), the
+CPU default and reference semantics. The block-parallel Pallas decode
+kernel with the same call signature lives in ops/paged_attention.py; model
+decode steps pick between them via ``decode_attention``'s ``backend`` knob
+(threaded from EngineConfig.attention_backend). GQA never materializes
+repeated KV heads in either path: here the queries regroup onto their
+shared KV head and the einsums carry the group as a free axis.
 """
 from __future__ import annotations
 
@@ -115,21 +118,23 @@ def paged_prefill_attention(
     scale = scale if scale is not None else 1.0 / math.sqrt(hd)
     keys, values = gather_kv(k_layer, v_layer, block_tables)  # [B,T,Hkv,hd]
     Hkv = keys.shape[2]
-    if Hq != Hkv:
-        rep = Hq // Hkv
-        keys = jnp.repeat(keys, rep, axis=2)
-        values = jnp.repeat(values, rep, axis=2)
+    # GQA without materializing rep x copies of K/V: queries regroup onto
+    # their shared KV head ([B,S,Hq,hd] -> [B,S,Hkv,G,hd] — query head h
+    # serves kv head h // G) and the einsums contract against the COMPACT
+    # keys/values, carrying the group as a free axis.
+    q = q.reshape(B, S, Hkv, Hq // Hkv, hd)
     logits = jnp.einsum(
-        "bshd,bthd->bsht", q, keys, preferred_element_type=jnp.float32
+        "bshgd,bthd->bshgt", q, keys, preferred_element_type=jnp.float32
     ) * scale
     T = keys.shape[1]
     mask = (
         jnp.arange(T, dtype=positions.dtype)[None, None, :]
         <= positions[:, :, None]
     )  # [B, S, T]
-    logits = jnp.where(mask[:, :, None, :], logits, NEG_INF)
+    logits = jnp.where(mask[:, :, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(values.dtype)
-    return jnp.einsum("bsht,bthd->bshd", probs, values).astype(q.dtype)
+    out = jnp.einsum("bshgt,bthd->bshgd", probs, values)
+    return out.reshape(B, S, Hq, hd).astype(q.dtype)
 
 
 def _copy_blocks(
@@ -168,21 +173,22 @@ def paged_attention(
     q: [B, H_q, hd] (the current token's query, AFTER its own k/v were
     written, so the mask `t <= position` includes self-attention).
     Returns [B, H_q, hd] in q.dtype. GQA: H_q may be a multiple of the
-    cache's H_kv; kv heads are repeated (same policy as ops/attention.py).
+    cache's H_kv; the query group attends against the compact KV heads
+    (no repeat — grouped einsum).
     """
     B, Hq, hd = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(hd)
     keys, values = gather_kv(k_layer, v_layer, block_tables)  # [B, T, Hkv, hd]
     Hkv = keys.shape[2]
-    if Hq != Hkv:
-        rep = Hq // Hkv
-        keys = jnp.repeat(keys, rep, axis=2)
-        values = jnp.repeat(values, rep, axis=2)
+    # GQA via grouped einsum over the compact KV heads (see
+    # paged_prefill_attention) — no rep x K/V expansion in HBM.
+    q = q.reshape(B, Hkv, Hq // Hkv, hd)
     logits = jnp.einsum(
-        "bhd,bthd->bht", q, keys, preferred_element_type=jnp.float32
+        "bhgd,bthd->bhgt", q, keys, preferred_element_type=jnp.float32
     ) * scale
     T = keys.shape[1]
     mask = jnp.arange(T, dtype=positions.dtype)[None, :] <= positions[:, None]
-    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(values.dtype)
-    return jnp.einsum("bht,bthd->bhd", probs, values).astype(q.dtype)
+    out = jnp.einsum("bhgt,bthd->bhgd", probs, values)
+    return out.reshape(B, Hq, hd).astype(q.dtype)

@@ -1,0 +1,259 @@
+"""Fused Pallas paged-attention decode kernel (block-table-aware, GQA-compact).
+
+The TPU counterpart of ``ops/kv_cache.py::paged_attention`` for the
+single-token decode hot loop. The XLA formulation gathers every sequence's
+ENTIRE padded context (``gather_kv`` → ``[B, NB*block_size, H_kv, hd]``
+in HBM) and repeats KV heads for GQA before a masked softmax — HBM traffic
+inflated by the padding factor times the GQA repeat factor on an op that is
+purely bandwidth-bound. This kernel is the vLLM PagedAttention shape
+instead: one ``pallas_call`` whose grid walks each sequence's block table
+and DMAs K/V **directly from the paged pool**
+(``[num_blocks, block_size, n_kv_head, hd]``) block-by-block into VMEM.
+Nothing is ever materialized at the padded context length, no head is ever
+repeated.
+
+Design (same playbook as ``ops/attention.py``'s flash kernels):
+
+- BLOCK-TABLE WALK VIA SCALAR PREFETCH: the block table and positions ride
+  in as ``PrefetchScalarGridSpec`` scalar operands, so the K/V BlockSpec
+  index maps read ``tables[b, i]`` and point each grid step's DMA at the
+  right physical block. Table entries wholly past a sequence's length
+  re-issue the previous step's block index, which Pallas dedupes into NO
+  DMA at all — padding costs neither bandwidth nor compute.
+- GQA COMPACTION: queries reshape ``[B, H_q, hd] → [B, H_kv, G, hd]``
+  (``G = H_q // H_kv``) and the grid iterates KV heads; each step computes
+  the whole query group against the SHARED KV block with one batched dot,
+  so GQA is a free extra row dimension instead of a ``rep``× KV copy.
+- FLASH RUNNING SOFTMAX: per-(b, kv-head) running max / sum / accumulator
+  live in VMEM scratch across the innermost block axis; the softmax is
+  base-2 with ``scale * log2(e)`` folded into q once (exp2 instead of
+  exp, no rescale pass), bf16 inputs run the exp2 at half precision.
+
+Sharded executors (serve/llm/executor.py ``ShardedExecutor``) split the
+pool's KV-head axis over tp. The kernel is head-count-agnostic — the grid
+reads ``H_kv`` from the array it is handed, so each GSPMD shard runs the
+identical program over its local heads (per-shard head count; an explicit
+shard_map wrap is equivalent and not required). On CPU the kernel runs in
+interpret mode (pure-XLA lowering, same policy as ``flash_attention``), so
+tier-1 tests execute the real kernel code and GSPMD partitions it like any
+other HLO.
+
+``decode_attention`` is the dispatcher the model decode steps call: the
+``backend`` knob ("auto" | "xla" | "pallas") threads down from
+``EngineConfig.attention_backend`` via the model config, with "auto"
+resolving to the Pallas kernel on TPU and the XLA formulation elsewhere
+(CPU interpret-mode grids are trace-time-unrolled — correct, but not a
+default worth paying for).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import LOG2E, NEG_INF
+
+BACKENDS = ("auto", "xla", "pallas")
+
+
+def _tpu_compiler_params(**kwargs):
+    """Build TPU compiler params across jax versions: the class was named
+    ``TPUCompilerParams`` before being renamed ``CompilerParams``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def resolve_backend(backend: str) -> str:
+    """Normalize the attention_backend knob to a concrete backend."""
+    if backend == "auto":
+        return (
+            "pallas"
+            if jax.devices()[0].platform in ("tpu", "axon")
+            else "xla"
+        )
+    if backend not in ("xla", "pallas"):
+        raise ValueError(
+            f"attention_backend must be one of {BACKENDS}, got {backend!r}"
+        )
+    return backend
+
+
+def _paged_decode_kernel(
+    tables_ref,  # scalar prefetch: [B, NB] int32 block tables
+    pos_ref,     # scalar prefetch: [B] int32 positions (mask is t <= pos)
+    q_ref,       # [1, 1, G, hd] — this (b, kv-head)'s query group, pre-scaled
+    k_ref,       # [1, bs, 1, hd] — one physical KV block, one kv head
+    v_ref,       # [1, bs, 1, hd]
+    o_ref,       # [1, 1, G, hd]
+    m_scr,       # VMEM [G, 128] f32 running max (lane-broadcast)
+    l_scr,       # VMEM [G, 128] f32 running sum (lane-broadcast)
+    acc_scr,     # VMEM [G, hd] f32 output accumulator
+    *,
+    block_size: int,
+):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    n_nb = pl.num_programs(2)
+    pos = pos_ref[b]
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Blocks that start past the sequence's last valid position contribute
+    # nothing; their (deduped) fetch is skipped and so is their compute.
+    @pl.when(i * block_size <= pos)
+    def _compute():
+        q = q_ref[0, 0]        # [G, hd], pre-scaled by scale * log2(e)
+        k = k_ref[0, :, 0, :]  # [bs, hd]
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                      # [G, bs]
+        t = i * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(t <= pos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                       # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # bf16 inputs: exp2 at half precision (2x VPU lanes), matching the
+        # flash forward; f32 inputs keep a fully-f32 softmax
+        if q.dtype == jnp.bfloat16:
+            p = jnp.exp2((s - m_new).astype(jnp.bfloat16))
+        else:
+            p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(
+            p, axis=1, keepdims=True, dtype=jnp.float32
+        )
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(i == n_nb - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(
+    q: jax.Array,
+    k_layer: jax.Array,
+    v_layer: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Single-token decode attention straight off the paged KV pool.
+
+    Same contract as ``ops/kv_cache.paged_attention``: q ``[B, H_q, hd]``
+    (the current token's query, AFTER its own k/v were written, so the
+    ``t <= position`` mask includes self), pool layers
+    ``[num_blocks, block_size, H_kv, hd]``, ``block_tables`` ``[B, NB]``
+    int32 padded with the garbage block 0, ``positions`` ``[B]`` int32.
+    Returns ``[B, H_q, hd]`` in q.dtype. ``interpret`` defaults to True
+    off-TPU so tests execute the kernel on CPU.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    B, Hq, hd = q.shape
+    _, bs, Hkv, _ = k_layer.shape
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of KV heads ({Hkv})"
+        )
+    G = Hq // Hkv
+    NB = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    # fold softmax scale AND log2(e) into q once — base-2 softmax in-kernel.
+    # Query head h serves kv head h // G, so [B, Hq, hd] -> [B, Hkv, G, hd]
+    # is exactly the jnp.repeat head mapping, compacted.
+    qf = (q * jnp.asarray(scale * LOG2E, q.dtype)).reshape(B, Hkv, G, hd)
+    tables = block_tables.astype(jnp.int32)
+    pos = positions.astype(jnp.int32)
+
+    def q_map(b, h, i, tables_ref, pos_ref):
+        return (b, h, 0, 0)
+
+    def kv_map(b, h, i, tables_ref, pos_ref):
+        # Walk the sequence's block table. Entries wholly past the last
+        # valid position re-issue entry 0's index: consecutive identical
+        # block tuples make Pallas skip the DMA, so table padding costs
+        # no bandwidth (the kernel skips their compute by the same test).
+        entry = jnp.where(
+            i * bs <= pos_ref[b], tables_ref[b, i], tables_ref[b, 0]
+        )
+        return (entry, 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, NB),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, hd), q_map),
+            pl.BlockSpec((1, bs, 1, hd), kv_map),
+            pl.BlockSpec((1, bs, 1, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, block_size=bs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
+        compiler_params=_tpu_compiler_params(
+            vmem_limit_bytes=100 * 1024 * 1024,
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(tables, pos, qf, k_layer, v_layer)
+    return out.reshape(B, Hq, hd)
+
+
+def decode_attention(
+    q: jax.Array,
+    k_layer: jax.Array,
+    v_layer: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: float | None = None,
+    backend: str = "auto",
+) -> jax.Array:
+    """Backend dispatcher for decode attention — the one entry point the
+    model decode steps call. ``backend`` is the ``attention_backend`` knob
+    threaded from ``EngineConfig`` through the model config; "auto" picks
+    the Pallas kernel on TPU and the XLA formulation elsewhere. Both
+    backends share the exact call signature and numerics contract
+    (token streams are byte-identical — tests/test_paged_attention.py)."""
+    if resolve_backend(backend) == "pallas":
+        return paged_attention_pallas(
+            q, k_layer, v_layer, block_tables, positions, scale=scale
+        )
+    from ray_tpu.ops.kv_cache import paged_attention as _xla_paged_attention
+
+    return _xla_paged_attention(
+        q, k_layer, v_layer, block_tables, positions, scale=scale
+    )

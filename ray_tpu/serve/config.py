@@ -67,16 +67,28 @@ class ModelParallelConfig:
     single-device executor and changes nothing. Passed as the ``mesh``
     field of ``EngineConfig`` (or via ``LLMDeployment`` /
     ``build_llm_app`` plumbing).
+
+    ``attention_backend`` selects the decode attention kernel for the
+    replica (None -> the engine/model default; "auto" | "xla" |
+    "pallas" — ops/paged_attention.py). The Pallas kernel is
+    head-count-agnostic, so it runs per tp shard over the pool's local
+    KV heads with no extra collective.
     """
 
     tp: int = 1
     fsdp: int = 1
+    attention_backend: str | None = None
 
     def __post_init__(self):
         if self.tp < 1 or self.fsdp < 1:
             raise ValueError(
                 f"tp and fsdp must be >= 1, got tp={self.tp} "
                 f"fsdp={self.fsdp}"
+            )
+        if self.attention_backend not in (None, "auto", "xla", "pallas"):
+            raise ValueError(
+                "attention_backend must be None, 'auto', 'xla', or "
+                f"'pallas', got {self.attention_backend!r}"
             )
 
     @property

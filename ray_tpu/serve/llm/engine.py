@@ -168,6 +168,16 @@ class EngineConfig:
     mesh: Any = None
     tp: int = 1      # tensor-parallel ways (heads/mlp/vocab + KV heads)
     fsdp: int = 1    # fsdp ways (embed axis of every weight)
+    # ---- decode attention backend (ops/paged_attention.py) ----
+    # None -> respect the model config's attention_backend (default
+    # "auto": the fused Pallas paged-attention kernel on TPU, the XLA
+    # gather formulation elsewhere). "xla" | "pallas" force a backend;
+    # "auto" forces the platform default. The knob is STATIC in the
+    # jitted step (it rides the frozen model config), so switching it
+    # never adds a compile kind — signatures stay
+    # (prefill, prefill_chunk, decode) x buckets, and token streams are
+    # byte-identical across backends (tests/test_paged_attention.py).
+    attention_backend: str | None = None
 
 
 class TokenStream:
@@ -298,6 +308,28 @@ class LLMEngine:
                 from ray_tpu.models.llama import LlamaConfig
 
                 model_cfg = LlamaConfig.tiny()
+        # thread the decode-attention backend into the (static) model
+        # config: EngineConfig wins, then a ModelParallelConfig-style
+        # mesh object's knob, else the model config keeps its own
+        backend = cfg.attention_backend
+        if backend is None:
+            backend = getattr(cfg.mesh, "attention_backend", None)
+        if backend is None:
+            backend = getattr(model_cfg, "attention_backend", "xla")
+        # Resolve "auto" to the platform's concrete backend HERE (also
+        # validates the knob): the resolved value lands in the frozen
+        # model config, so engines that spell the same effective backend
+        # differently ("auto" on CPU vs explicit "xla") share one
+        # decode.py _jit_cache entry instead of compiling twice.
+        from ray_tpu.ops.paged_attention import resolve_backend
+
+        backend = resolve_backend(backend)
+        if getattr(model_cfg, "attention_backend", None) != backend:
+            import dataclasses
+
+            model_cfg = dataclasses.replace(
+                model_cfg, attention_backend=backend
+            )
         self.cfg = cfg
         self.model_cfg = model_cfg
         n_kv = getattr(model_cfg, "n_kv_head", model_cfg.n_head)

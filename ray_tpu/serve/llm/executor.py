@@ -192,14 +192,27 @@ class ModelExecutor:
     # ---------------- introspection ----------------
 
     @property
+    def attention_backend(self) -> str:
+        """The RESOLVED decode-attention backend the jitted model steps
+        traced with ("xla" | "pallas") — the model config's knob with
+        "auto" collapsed to the platform default."""
+        from ray_tpu.ops.paged_attention import resolve_backend
+
+        return resolve_backend(
+            getattr(self.model_cfg, "attention_backend", "xla")
+        )
+
+    @property
     def num_devices(self) -> int:
         return 1
 
     def describe(self) -> dict:
         """Stable summary for stats()/debug_dump()/benchmarks: which
-        executor is serving and over how many devices."""
+        executor is serving, over how many devices, and which decode
+        attention backend the model steps compiled with."""
         return {"executor": self.kind, "devices": self.num_devices,
-                "mesh": None}
+                "mesh": None,
+                "attention_backend": self.attention_backend}
 
 
 class SingleDeviceExecutor(ModelExecutor):
@@ -326,6 +339,7 @@ class ShardedExecutor(ModelExecutor):
             # the operator-facing mesh shape
             "mesh": {a: int(s) for a, s in self.mesh.shape.items()
                      if int(s) > 1},
+            "attention_backend": self.attention_backend,
         }
 
 

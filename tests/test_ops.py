@@ -171,6 +171,128 @@ def test_gpt_loss_fused_vs_unfused(jax_cpu):
     assert max(jax.tree.leaves(err)) < 1e-4, err
 
 
+def _paged_setup(key, lengths, n_kv_head, head_dim, block_size, n_blocks_per_seq,
+                 shuffle):
+    """Build a paged KV pool holding ragged sequences.
+
+    Returns (k_contig, v_contig, k_layer, v_layer, block_tables): contiguous
+    [B, T_cap, Hkv, hd] K/V alongside the same tokens scattered into a
+    paged pool via write_kv. Block 0 is the garbage sink: the pool is
+    pre-filled with noise (so any accidental read of an unowned block is
+    loud), tables of sequences shorter than the capacity are padded with 0,
+    and `shuffle` scrambles the physical id assignment so tests cover
+    non-contiguous layouts."""
+    import random as _random
+
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.kv_cache import write_kv
+
+    B = len(lengths)
+    T_cap = n_blocks_per_seq * block_size
+    assert max(lengths) <= T_cap
+    num_blocks = 1 + B * n_blocks_per_seq
+    ids = list(range(1, num_blocks))
+    if shuffle:
+        _random.Random(1234).shuffle(ids)
+    table_rows, next_id = [], 0
+    for L in lengths:
+        needed = -(-L // block_size)  # ceil
+        row = ids[next_id:next_id + needed] + [0] * (n_blocks_per_seq - needed)
+        next_id += needed
+        table_rows.append(row)
+    block_tables = jnp.asarray(table_rows, jnp.int32)
+
+    k_contig = jax.random.normal(
+        jax.random.fold_in(key, 1), (B, T_cap, n_kv_head, head_dim)
+    )
+    v_contig = jax.random.normal(
+        jax.random.fold_in(key, 2), (B, T_cap, n_kv_head, head_dim)
+    )
+    pool_shape = (num_blocks, block_size, n_kv_head, head_dim)
+    k_layer = jax.random.normal(jax.random.fold_in(key, 3), pool_shape)
+    v_layer = jax.random.normal(jax.random.fold_in(key, 4), pool_shape)
+    pos = jnp.broadcast_to(jnp.arange(T_cap, dtype=jnp.int32), (B, T_cap))
+    valid = pos < jnp.asarray(lengths, jnp.int32)[:, None]
+    k_layer, v_layer = write_kv(
+        k_layer, v_layer, k_contig, v_contig, pos, block_tables, valid=valid
+    )
+    return k_contig, v_contig, k_layer, v_layer, block_tables
+
+
+@pytest.mark.parametrize("gqa", [1, 2, 4])
+@pytest.mark.parametrize("shuffle", [False, True])
+def test_paged_attention_matches_reference(jax_cpu, gqa, shuffle):
+    """Decode-time paged attention == mha_reference's causal row at each
+    sequence's last position, over ragged lengths, block-0-padded tables,
+    and (shuffle=True) scrambled physical block ids."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.attention import mha_reference
+    from ray_tpu.ops.kv_cache import paged_attention
+
+    key = jax.random.PRNGKey(10 + gqa)
+    lengths = [1, 7, 16, 29]
+    Hkv, hd, bs, NB = 2, 32, 8, 4
+    Hq = Hkv * gqa
+    kc, vc, k_layer, v_layer, tables = _paged_setup(
+        key, lengths, Hkv, hd, bs, NB, shuffle
+    )
+    B, T_cap = kc.shape[:2]
+    q_full = jax.random.normal(jax.random.fold_in(key, 5), (B, T_cap, Hq, hd))
+    ref_full = mha_reference(  # [B, Hq, T_cap, hd]
+        q_full.transpose(0, 2, 1, 3),
+        kc.transpose(0, 2, 1, 3),
+        vc.transpose(0, 2, 1, 3),
+        causal=True,
+    )
+    positions = jnp.asarray(lengths, jnp.int32) - 1
+    q = jnp.take_along_axis(
+        q_full, positions[:, None, None, None], axis=1
+    )[:, 0]  # [B, Hq, hd]
+    out = paged_attention(q, k_layer, v_layer, tables, positions)
+    ref = jnp.take_along_axis(
+        ref_full, positions[:, None, None, None], axis=2
+    )[:, :, 0]
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5, (gqa, shuffle)
+
+
+@pytest.mark.parametrize("gqa", [1, 2, 4])
+def test_paged_prefill_attention_matches_reference(jax_cpu, gqa):
+    """Chunked-prefill paged attention == causal mha_reference on every
+    valid (non-padding) query row, shuffled tables + ragged lengths."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.attention import mha_reference
+    from ray_tpu.ops.kv_cache import paged_prefill_attention
+
+    key = jax.random.PRNGKey(20 + gqa)
+    lengths = [3, 12, 32, 17]
+    Hkv, hd, bs, NB = 2, 16, 8, 4
+    Hq = Hkv * gqa
+    kc, vc, k_layer, v_layer, tables = _paged_setup(
+        key, lengths, Hkv, hd, bs, NB, shuffle=True
+    )
+    B, T_cap = kc.shape[:2]
+    q_full = jax.random.normal(jax.random.fold_in(key, 5), (B, T_cap, Hq, hd))
+    lens = jnp.asarray(lengths, jnp.int32)
+    t = jnp.arange(T_cap, dtype=jnp.int32)
+    # padding queries get clamped positions; their rows are discarded below
+    positions = jnp.minimum(t[None, :], lens[:, None] - 1)
+    out = paged_prefill_attention(
+        q_full, k_layer, v_layer, tables, positions
+    )  # [B, T_cap, Hq, hd]
+    ref = mha_reference(
+        q_full.transpose(0, 2, 1, 3),
+        kc.transpose(0, 2, 1, 3),
+        vc.transpose(0, 2, 1, 3),
+        causal=True,
+    ).transpose(0, 2, 1, 3)  # back to [B, T_cap, Hq, hd]
+    valid = (t[None, :] < lens[:, None])[:, :, None, None]
+    err = jnp.max(jnp.abs(jnp.where(valid, out - ref, 0.0)))
+    assert float(err) < 2e-5, gqa
+
+
 def test_flash_attention_odd_bh_and_seq(jax_cpu):
     """Regression: group size must divide batch*heads (bh=12 with the cap
     at 8 once silently skipped heads 8-11), and default 1024 blocks must

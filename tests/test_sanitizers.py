@@ -226,6 +226,78 @@ def test_one_clock_in_llm_serving_path():
     )
 
 
+def test_decode_attention_path_never_materializes_kv():
+    """Decode-perf lint (ISSUE 8): the single-token decode attention call
+    graph must stay fused. ``gather_kv`` materializes [B, NB*bs, Hkv, hd]
+    per layer per step and ``jnp.repeat`` blows compact GQA KV heads up
+    rep x — either one silently reintroduces the O(T) HBM traffic the
+    paged kernels exist to avoid. Scope: all of ops/paged_attention.py
+    (both the Pallas kernel and the dispatcher), everything lexically
+    inside the models' ``*_decode_step`` (including the nested scan
+    ``body`` closures), and — for the XLA fallback's GQA math — the
+    repeat ban alone in kv_cache's two paged attention functions
+    (``gather_kv`` is that formulation's legitimate core)."""
+    import ast
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+
+    def offending_calls(path, banned, within=None):
+        """(lineno, name) of calls to `banned` names in `path` — restricted,
+        when `within` is given, to calls whose ANCESTOR function chain
+        touches one of those names (decode steps nest closures, so tagging
+        only the innermost function would miss the scan body)."""
+        tree = ast.parse(path.read_text(), filename=str(path))
+        chains: dict[ast.AST, frozenset] = {}
+
+        def tag(node, chain):
+            for child in ast.iter_child_nodes(node):
+                c = chain
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    c = chain | {child.name}
+                chains[child] = c
+                tag(child, c)
+
+        tag(tree, frozenset())
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if within is not None and not (chains.get(node, frozenset()) & within):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                name = f.id
+            elif isinstance(f, ast.Attribute):
+                name = f.attr
+            else:
+                continue
+            if name in banned:
+                out.append(f"{path.relative_to(root)}:{node.lineno} ({name})")
+        return out
+
+    offenders = []
+    offenders += offending_calls(
+        root / "ray_tpu" / "ops" / "paged_attention.py",
+        banned={"gather_kv", "repeat"},
+    )
+    for model, step in (("gpt.py", "gpt_decode_step"),
+                        ("llama.py", "llama_decode_step")):
+        offenders += offending_calls(
+            root / "ray_tpu" / "models" / model,
+            banned={"gather_kv", "repeat"},
+            within={step},
+        )
+    offenders += offending_calls(
+        root / "ray_tpu" / "ops" / "kv_cache.py",
+        banned={"repeat"},
+        within={"paged_attention", "paged_prefill_attention"},
+    )
+    assert not offenders, (
+        f"materializing ops in the decode attention path: {offenders}"
+    )
+
+
 SCHED_DRIVER = r"""
 #include <cstdint>
 #include <cstdio>

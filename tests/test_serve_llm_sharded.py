@@ -96,7 +96,8 @@ def test_sharded_executor_shards_kv_pool_head_axis(jax_cpu):
     assert _kv_tp_axis(eng.cache.v) == "tp"
     st = eng.stats()
     assert st["executor"] == {"executor": "sharded", "devices": 4,
-                              "mesh": {"tp": 2, "fsdp": 2}}
+                              "mesh": {"tp": 2, "fsdp": 2},
+                              "attention_backend": "xla"}
     assert eng.debug_dump()["executor"]["mesh"] == {"tp": 2, "fsdp": 2}
 
 
@@ -108,7 +109,8 @@ def test_single_device_default_unchanged(jax_cpu):
     eng = _engine("llama", _model_config("llama"))
     assert isinstance(eng.executor, SingleDeviceExecutor)
     assert eng.stats()["executor"] == {"executor": "single", "devices": 1,
-                                       "mesh": None}
+                                       "mesh": None,
+                                       "attention_backend": "xla"}
     assert len(eng.generate([5, 6, 7], max_new_tokens=4)) == 4
 
 
