@@ -24,8 +24,24 @@ Reported (one JSON line, merged into bench.py's aux results under
                               the dispatch-ahead pipeline (engine.py)
                               sits on its lag-1 fast path — generated
                               tokens / decode-step wall time
-- ``llm_decode_step_p50_ms``  median wall time of one steady decode
-                              step (dispatch + lagged O(batch) sync)
+- ``llm_decode_step_p50_ms`` / ``llm_decode_step_p99_ms``
+                              median and tail wall time of one steady
+                              decode step (dispatch + lagged O(batch)
+                              sync) — the p99 catches pipeline stalls
+                              (lag collapses, compiles) the median hides
+- ``llm_spec_decode_tokens_per_sec`` / ``llm_spec_accept_rate`` /
+  ``llm_spec_committed_per_step``
+                              speculative decoding (EngineConfig
+                              speculative_k + the n-gram drafter) on a
+                              repeating-structure prompt: decode
+                              throughput with speculation on, the draft
+                              acceptance rate, and mean tokens COMMITTED
+                              per verify step (>1 = the multi-token path
+                              is real); ``llm_spec_lossless`` asserts
+                              the stream matched the non-speculative run
+                              byte-for-byte, ``llm_spec_baseline_tokens_
+                              per_sec`` is the same workload with
+                              speculation off (the speedup denominator)
 - ``llm_sharded_decode_tokens_per_sec`` / ``llm_sharded_decode_step_p50_ms``
                               the same steady-decode phase on a tp/fsdp
                               ShardedExecutor engine (serve/llm/
@@ -42,7 +58,12 @@ Reported (one JSON line, merged into bench.py's aux results under
                               tracks the kernel against the XLA
                               formulation release-over-release (on CPU
                               the Pallas number is interpret-mode, so it
-                              bounds correctness cost, not TPU perf)
+                              bounds correctness cost, not TPU perf);
+                              ``llm_paged_attn_shape`` records the shape
+                              measured (env-overridable via
+                              RAY_TPU_PAGED_ATTN_SHAPE), and a second
+                              GQA-heavy point reports under
+                              ``llm_paged_attn_gqa_*``
 
 Runs on CPU with the tiny llama config — the point is tracking the
 scheduler/cache overheads and the hit-rate plumbing release-over-release,
@@ -63,12 +84,21 @@ MAX_NEW_TOKENS = 8
 # inside the context bucket the warm waves already compiled (96+4+24 < 128)
 STEADY_NEW_TOKENS = 24
 SHARDED_DEVICES = 8   # virtual CPU devices for the sharded-decode phase
-# decode-attention microbench: fixed [B, Hq, Hkv, hd] decode shape over a
-# bs x NB paged pool (T = 128 cached tokens of capacity per sequence)
+# decode-attention microbench: default [B, Hq, Hkv, hd] decode shape over
+# a bs x NB paged pool (T = 128 cached tokens of capacity per sequence).
+# Override with RAY_TPU_PAGED_ATTN_SHAPE="B,Hq,Hkv,hd" (or x-separated) to
+# probe a production shape without editing the bench.
 PAGED_ATTN_SHAPE = (8, 4, 2, 64)
+# second fixed point: GQA-heavier ratio (8 query heads per KV head) — the
+# regime the Pallas kernel's grouped-query packing is built for
+PAGED_ATTN_GQA_SHAPE = (8, 16, 2, 64)
 PAGED_ATTN_BLOCK = 16
 PAGED_ATTN_NBLOCKS = 8
 PAGED_ATTN_ITERS = 20
+# speculative-decoding phase: draft window and generation budget sized so
+# the n-gram drafter locks onto the repeating motif within the run
+SPEC_K = 4
+SPEC_NEW_TOKENS = 48
 
 
 def _ensure_virtual_devices(n: int) -> None:
@@ -212,6 +242,10 @@ def run_serving_bench() -> dict:
             float(np.percentile(steady_step_s, 50)) * 1e3, 3
         )
         if steady_step_s else None,
+        "llm_decode_step_p99_ms": round(
+            float(np.percentile(steady_step_s, 99)) * 1e3, 3
+        )
+        if steady_step_s else None,
         "llm_warm_decode_tokens_per_sec": round(
             generated / max(warm_decode_s, 1e-9), 1
         ),
@@ -303,13 +337,41 @@ def run_sharded_decode_bench() -> dict:
     }
 
 
-def run_paged_attn_microbench() -> dict:
+def _paged_attn_env_shape() -> tuple[int, int, int, int] | None:
+    """Parse RAY_TPU_PAGED_ATTN_SHAPE ("B,Hq,Hkv,hd"; ',' or 'x'
+    separated). Returns None when unset; raises on malformed values so a
+    typo'd override fails loudly instead of silently benching the
+    default shape."""
+    raw = os.environ.get("RAY_TPU_PAGED_ATTN_SHAPE", "").strip()
+    if not raw:
+        return None
+    parts = [p for p in raw.replace("x", ",").split(",") if p.strip()]
+    if len(parts) != 4:
+        raise ValueError(
+            f"RAY_TPU_PAGED_ATTN_SHAPE must be 4 ints (B,Hq,Hkv,hd), "
+            f"got {raw!r}"
+        )
+    return tuple(int(p) for p in parts)  # type: ignore[return-value]
+
+
+def run_paged_attn_microbench(
+    shape: tuple[int, int, int, int] | None = None,
+    *,
+    block_size: int | None = None,
+    num_blocks: int | None = None,
+    prefix: str = "llm_paged_attn",
+) -> dict:
     """Decode attention isolated from the engine: one jitted
     ``decode_attention`` per backend at a fixed decode shape, median wall
     ms over ``PAGED_ATTN_ITERS`` calls. Shuffled block tables + ragged
     positions so both paths pay realistic gather/walk patterns. The two
     backends share inputs; a byte-comparison here would be redundant with
-    tests/test_paged_attention.py — this phase only times."""
+    tests/test_paged_attention.py — this phase only times.
+
+    ``shape`` is [B, Hq, Hkv, hd]; when None the
+    RAY_TPU_PAGED_ATTN_SHAPE env override applies, then
+    ``PAGED_ATTN_SHAPE``. ``prefix`` names the emitted keys so main()
+    can report several shape points side by side."""
     import numpy as np
 
     import jax
@@ -317,8 +379,11 @@ def run_paged_attn_microbench() -> dict:
 
     from ray_tpu.ops.paged_attention import decode_attention
 
-    B, Hq, Hkv, hd = PAGED_ATTN_SHAPE
-    bs, NB = PAGED_ATTN_BLOCK, PAGED_ATTN_NBLOCKS
+    if shape is None:
+        shape = _paged_attn_env_shape() or PAGED_ATTN_SHAPE
+    B, Hq, Hkv, hd = shape
+    bs = PAGED_ATTN_BLOCK if block_size is None else block_size
+    NB = PAGED_ATTN_NBLOCKS if num_blocks is None else num_blocks
     key = jax.random.PRNGKey(42)
     rng = np.random.default_rng(42)
     num_blocks = 1 + B * NB
@@ -337,7 +402,7 @@ def run_paged_attn_microbench() -> dict:
     )
 
     out: dict = {
-        "llm_paged_attn_shape": {
+        f"{prefix}_shape": {
             "B": B, "Hq": Hq, "Hkv": Hkv, "hd": hd,
             "block_size": bs, "T": bs * NB,
         }
@@ -354,17 +419,90 @@ def run_paged_attn_microbench() -> dict:
             t0 = time.perf_counter()
             fn(q, k_layer, v_layer, tables, positions).block_until_ready()
             samples.append(time.perf_counter() - t0)
-        out[f"llm_paged_attn_{backend}_ms"] = round(
+        out[f"{prefix}_{backend}_ms"] = round(
             float(np.percentile(samples, 50)) * 1e3, 3
         )
     return out
 
 
+def run_spec_decode_bench() -> dict:
+    """Speculative decoding on a repeating-structure prompt: the same
+    single-stream generation run twice — speculation off (the baseline)
+    and on (``speculative_k=SPEC_K`` with the n-gram drafter) — through
+    fresh engines sharing the process-wide jit cache, so the second run
+    of each mode's step functions is compile-free. The prompt is a short
+    random motif repeated, which greedy decode of the tiny model extends
+    periodically — the regime prompt-lookup drafting targets (and the
+    regime real serving hits on code/JSON/few-shot traffic). Asserts the
+    two streams are byte-identical (``llm_spec_lossless``) — speculation
+    is a perf knob here, never a quality knob."""
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    mc = LlamaConfig.tiny()
+    rng = np.random.default_rng(7)
+    motif = [int(t) for t in rng.integers(1, mc.vocab_size, 8)]
+    prompt = motif * 3
+
+    def run(k: int) -> tuple[list[int], float, dict]:
+        eng = LLMEngine(
+            EngineConfig(
+                model="llama",
+                model_config=mc,
+                block_size=8,
+                num_blocks=64,
+                max_batch_size=4,
+                max_prefill_batch=4,
+                speculative_k=k,
+            ),
+            auto_step=False,
+        )
+        stream = eng.submit(prompt, max_new_tokens=SPEC_NEW_TOKENS)
+        t0 = time.perf_counter()
+        for _ in range(10_000):
+            if stream.done or not eng.step():
+                break
+        while eng.step():  # collapse the trailing in-flight step
+            pass
+        dt = time.perf_counter() - t0
+        toks = list(stream)
+        st = eng.stats()
+        eng.shutdown()
+        return toks, dt, st
+
+    run(0)  # warm the jit cache for both modes (prefill/decode ...)
+    run(SPEC_K)  # ... and verify; measured runs below are compile-free
+    base_toks, base_s, _ = run(0)
+    spec_toks, spec_s, st = run(SPEC_K)
+    return {
+        "llm_spec_k": SPEC_K,
+        "llm_spec_lossless": base_toks == spec_toks,
+        "llm_spec_baseline_tokens_per_sec": round(
+            len(base_toks) / max(base_s, 1e-9), 1
+        ),
+        "llm_spec_decode_tokens_per_sec": round(
+            len(spec_toks) / max(spec_s, 1e-9), 1
+        ),
+        "llm_spec_accept_rate": round(st["spec_accept_rate"], 4),
+        "llm_spec_committed_per_step": round(
+            st["spec_committed_per_step"], 3
+        ),
+    }
+
+
 def main() -> None:
     _ensure_virtual_devices(SHARDED_DEVICES)
     out = run_serving_bench()
+    out.update(run_spec_decode_bench())
     out.update(run_sharded_decode_bench())
     out.update(run_paged_attn_microbench())
+    out.update(
+        run_paged_attn_microbench(
+            PAGED_ATTN_GQA_SHAPE, prefix="llm_paged_attn_gqa"
+        )
+    )
     print(json.dumps({"llm_serving": out}), flush=True)
 
 

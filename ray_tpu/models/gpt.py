@@ -464,6 +464,70 @@ def gpt_decode_step(
     return sample_tokens(logits, positions + 1, sample), cache_k, cache_v
 
 
+def gpt_verify_step(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    starts: jax.Array,
+    draft_len: jax.Array,
+    block_tables: jax.Array,
+    cfg: GPTConfig,
+    sample: dict | None = None,
+):
+    """Speculative-decoding verify pass; see models/llama.py
+    ``llama_verify_step`` for the full contract (window layout, K/V
+    discipline, packed return). This is the GPT-family twin: learned
+    positional embeddings indexed at the true window positions instead of
+    RoPE, and the tied-embedding logits head over ALL window positions
+    feeding the ``verify_tokens`` epilogue.
+    """
+    from ray_tpu.ops.kv_cache import paged_prefill_attention, write_kv
+
+    B, W = tokens.shape
+    D = cfg.d_model
+    pos = starts[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    # padding columns can run past the table; they are masked anyway
+    emb_pos = jnp.minimum(pos, cfg.max_seq_len - 1)
+    x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(
+        cfg.dtype
+    )[emb_pos]
+    valid = (
+        jnp.arange(W, dtype=jnp.int32)[None, :] <= draft_len[:, None]
+    )
+
+    def body(x, xs):
+        bp, k_layer, v_layer = xs
+        q, kk, vv = _attn_qkv(x, bp, cfg)
+        k_layer, v_layer = write_kv(
+            k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
+        )
+        attn = paged_prefill_attention(
+            q, k_layer, v_layer, block_tables, jnp.where(valid, pos, 0)
+        ).reshape(B, W, D)
+        x = _attn_residual(x, attn, bp, cfg)
+        x = _mlp_residual(x, bp, cfg)
+        return x, (k_layer, v_layer)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache_k, cache_v)
+    )
+    h = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])  # [B, W, D]
+    logits = jnp.einsum(
+        "bwd,vd->bwv", h.astype(cfg.dtype), params["wte"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    if sample is None:
+        return logits, cache_k, cache_v
+    from ray_tpu.ops.sampling import verify_tokens
+
+    return (
+        verify_tokens(logits, starts, tokens, draft_len, sample),
+        cache_k,
+        cache_v,
+    )
+
+
 def gpt_num_params(cfg: GPTConfig) -> int:
     p = gpt_init(jax.random.PRNGKey(0), cfg)
     return sum(x.size for x in jax.tree.leaves(p))

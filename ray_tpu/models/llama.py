@@ -501,6 +501,87 @@ def llama_decode_step(
     return sample_tokens(logits, positions + 1, sample), cache_k, cache_v
 
 
+def llama_verify_step(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    starts: jax.Array,
+    draft_len: jax.Array,
+    block_tables: jax.Array,
+    cfg: LlamaConfig,
+    sample: dict | None = None,
+):
+    """Speculative-decoding verify pass: score a [B, W] window in one call.
+
+    ``tokens`` [B, W] int32 — column 0 is row b's last COMMITTED token
+    (true position ``starts`` [B]; its K/V is not yet cached, exactly as in
+    a decode step), columns 1..W-1 are drafted candidates; columns past
+    ``draft_len`` [B] are padding. The body is the chunked-prefill
+    formulation at true positions (RoPE indexed per position, K/V written
+    for the valid window, ``paged_prefill_attention`` over the full paged
+    context) but keeps logits at ALL window positions instead of the last
+    valid one, feeding the ``verify_tokens`` epilogue (ops/sampling.py).
+
+    K/V discipline: valid columns write at their own positions — for
+    accepted drafts that IS the correct cache entry (accepted prefix =>
+    identical context => identical K/V). Rejected drafts leave garbage
+    only BEYOND the committed frontier, where the causal mask
+    ``t <= position`` keeps it unattended until the frontier's next window
+    overwrites those positions; no rollback pass is needed. Padding
+    columns are redirected to the garbage block, so reservations only need
+    to cover ``draft_len`` positions past the frontier.
+
+    Returns (packed verdicts [B, W+1] int32 — see ``verify_tokens``,
+    cache_k', cache_v'); with ``sample=None`` returns the raw window
+    logits [B, W, V] f32 instead of verdicts (debug path).
+    """
+    from ray_tpu.ops.kv_cache import paged_prefill_attention, write_kv
+
+    B, W = tokens.shape
+    D = cfg.d_model
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    cos, sin = rope_cache(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    pos = starts[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    # padding columns can run past the table; they are masked anyway
+    rope_pos = jnp.minimum(pos, cfg.max_seq_len - 1)
+    valid = (
+        jnp.arange(W, dtype=jnp.int32)[None, :] <= draft_len[:, None]
+    )
+
+    def body(x, xs):
+        bp, k_layer, v_layer = xs
+        q, kk, vv = _attn_qkv(x, bp, cos, sin, cfg, positions=rope_pos)
+        k_layer, v_layer = write_kv(
+            k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
+        )
+        attn = paged_prefill_attention(
+            q, k_layer, v_layer, block_tables, jnp.where(valid, pos, 0)
+        ).reshape(B, W, D)
+        x = x + attn @ bp["wo"].astype(cfg.dtype)
+        x, _ = _ffn_residual(x, bp, cfg)
+        return x, (k_layer, v_layer)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache_k, cache_v)
+    )
+    h = rms_norm(x, params["ln_f_scale"])  # [B, W, D]
+    logits = jnp.einsum(
+        "bwd,dv->bwv", h.astype(cfg.dtype),
+        params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    if sample is None:
+        return logits, cache_k, cache_v
+    from ray_tpu.ops.sampling import verify_tokens
+
+    return (
+        verify_tokens(logits, starts, tokens, draft_len, sample),
+        cache_k,
+        cache_v,
+    )
+
+
 def llama_num_params(cfg: LlamaConfig) -> int:
     p = llama_init(jax.random.PRNGKey(0), cfg)
     return sum(x.size for x in jax.tree.leaves(p))

@@ -100,3 +100,61 @@ def sample_tokens(
         return jnp.where(greedy_rows, greedy_toks, sampled)
 
     return jax.lax.cond(jnp.all(greedy_rows), all_greedy, mixed, None)
+
+
+def verify_tokens(
+    logits: jax.Array,
+    starts: jax.Array,
+    draft_tokens: jax.Array,
+    draft_len: jax.Array,
+    sample: dict,
+) -> jax.Array:
+    """Speculative-decoding rejection epilogue over a [B, W] verify window.
+
+    ``logits`` [B, W, V] f32 are the target model's outputs at window
+    columns 0..W-1, where column 0 held the last COMMITTED token (absolute
+    position ``starts`` [B]) and columns 1..W-1 held drafted candidates
+    ``draft_tokens`` [B, W] (column 0 is the committed token itself;
+    columns past ``draft_len`` [B] are padding). The logits at column s
+    predict the token at absolute position starts + s + 1, so the target
+    token for that position is the SAME pure function
+    f(logits, seed, position) as non-speculative decode — ``sample_tokens``
+    with keyed fold_in(seed, position) randomness.
+
+    Acceptance is exact-match, not a probability-ratio test: draft column
+    s is accepted iff it equals the target token the keyed sampler draws
+    at that position given the (accepted, hence true) prefix. By induction
+    the committed stream is byte-identical to non-speculative decoding —
+    losslessness holds for greedy AND temperature/top-k/top-p, because the
+    keyed sampler is deterministic per (logits, seed, position).
+
+    Returns packed [B, W + 1] int32: column 0 = committed count c in
+    1..draft_len+1 (accepted prefix plus one corrected/bonus token),
+    columns 1..W = the target tokens for positions starts+1..starts+W —
+    the committed tokens are packed[b, 1 : 1 + c]. One array => one
+    device->host sync per verify step.
+    """
+    B, W, _ = logits.shape
+    # target token for every window position, flattened through the [B, V]
+    # sampler with per-row sample leaves tiled across the window
+    positions = (
+        starts[:, None] + 1 + jnp.arange(W, dtype=jnp.int32)[None, :]
+    )  # [B, W]
+    tiled = {k: jnp.repeat(v, W, axis=0) for k, v in sample.items()}
+    tgt = sample_tokens(
+        logits.reshape(B * W, -1), positions.reshape(B * W), tiled
+    ).reshape(B, W)
+    # leading run of draft columns matching the target drawn one column
+    # earlier (logits at column s-1 predict position starts+s, which is
+    # where draft column s sits)
+    match = draft_tokens[:, 1:] == tgt[:, :-1]  # [B, W-1]
+    within = (
+        jnp.arange(1, W, dtype=jnp.int32)[None, :] <= draft_len[:, None]
+    )
+    accepted = jnp.sum(
+        jnp.cumprod((match & within).astype(jnp.int32), axis=1), axis=1
+    )  # [B] in 0..draft_len
+    committed = accepted + 1  # + the corrected/bonus target token
+    return jnp.concatenate(
+        [committed[:, None].astype(jnp.int32), tgt], axis=1
+    )

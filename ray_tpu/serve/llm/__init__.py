@@ -7,7 +7,8 @@ closed bucket set so the XLA compile cache stays bounded (arxiv
 2011.03641; SURVEY.md §7). Pieces:
 
 - kv_cache.py — block allocator + preallocated cache arrays + block tables
-- decode.py   — jitted prefill / single-token decode per model family
+- decode.py   — jitted prefill / decode / verify steps per model family
+- drafter.py  — host-side draft proposal for speculative decoding
 - executor.py — ModelExecutor seam: single-device or tp/fsdp-sharded
 - engine.py   — the continuous-batching scheduler (admission, join/evict)
 - api.py      — LLMDeployment: the engine as a streaming Serve deployment
@@ -22,6 +23,7 @@ from ray_tpu.exceptions import (
 )
 from ray_tpu.serve.config import ModelParallelConfig
 from ray_tpu.serve.llm.api import LLMDeployment, build_llm_app, stream_tokens
+from ray_tpu.serve.llm.drafter import Drafter, NGramDrafter, build_drafter
 from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, SamplingParams
 from ray_tpu.serve.llm.executor import (
     ModelExecutor,
@@ -33,6 +35,7 @@ from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
 
 __all__ = [
     "DeadlineExceededError",
+    "Drafter",
     "EngineConfig",
     "EngineDiedError",
     "EngineOverloadedError",
@@ -41,11 +44,13 @@ __all__ = [
     "LLMEngine",
     "ModelExecutor",
     "ModelParallelConfig",
+    "NGramDrafter",
     "PagedKVCache",
     "RequestCancelledError",
     "SamplingParams",
     "ShardedExecutor",
     "SingleDeviceExecutor",
+    "build_drafter",
     "build_executor",
     "stream_tokens",
     "build_llm_app",

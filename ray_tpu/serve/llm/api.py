@@ -226,6 +226,8 @@ def build_llm_app(
     mesh: Any = None,
     tp: int = 1,
     fsdp: int = 1,
+    speculative_k: int | None = None,
+    drafter: Any = None,
     **deployment_options: Any,
 ) -> Application:
     """Convenience: ``serve.run(build_llm_app(EngineConfig(...)))``.
@@ -234,14 +236,25 @@ def build_llm_app(
 
     ``mesh``/``tp``/``fsdp`` select the per-replica model-parallel
     layout (they override the EngineConfig fields of the same names);
-    the defaults keep every replica single-device."""
+    the defaults keep every replica single-device. ``speculative_k`` /
+    ``drafter`` likewise override the engine's speculative-decoding
+    knobs (docs/SERVING_LLM.md "Speculative decoding") — committed
+    streams stay byte-identical with speculation on or off, so mixed
+    fleets (some replicas speculative, some not) fail over freely."""
+    overrides: dict = {}
     if mesh is not None or tp != 1 or fsdp != 1:
+        overrides.update(mesh=mesh, tp=tp, fsdp=fsdp)
+    if speculative_k is not None:
+        overrides["speculative_k"] = int(speculative_k)
+    if drafter is not None:
+        overrides["drafter"] = drafter
+    if overrides:
         import dataclasses
 
         if isinstance(engine_config, dict):
             engine_config = EngineConfig(**engine_config)
         engine_config = dataclasses.replace(
-            engine_config or EngineConfig(), mesh=mesh, tp=tp, fsdp=fsdp
+            engine_config or EngineConfig(), **overrides
         )
     dep = LLMDeployment
     if deployment_options:

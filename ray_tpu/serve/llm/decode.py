@@ -14,9 +14,14 @@ from typing import Callable
 
 
 def _gpt_fns(model_cfg):
-    from ray_tpu.models.gpt import gpt_decode_step, gpt_init, gpt_prefill
+    from ray_tpu.models.gpt import (
+        gpt_decode_step,
+        gpt_init,
+        gpt_prefill,
+        gpt_verify_step,
+    )
 
-    return gpt_init, gpt_prefill, gpt_decode_step
+    return gpt_init, gpt_prefill, gpt_decode_step, gpt_verify_step
 
 
 def _llama_fns(model_cfg):
@@ -24,9 +29,10 @@ def _llama_fns(model_cfg):
         llama_decode_step,
         llama_init,
         llama_prefill,
+        llama_verify_step,
     )
 
-    return llama_init, llama_prefill, llama_decode_step
+    return llama_init, llama_prefill, llama_decode_step, llama_verify_step
 
 
 FAMILIES: dict[str, Callable] = {"gpt": _gpt_fns, "llama": _llama_fns}
@@ -64,11 +70,12 @@ def _jitted(family: str, model_cfg):
     if hit is None:
         import jax
 
-        init, prefill_fn, decode_fn = FAMILIES[family](model_cfg)
+        init, prefill_fn, decode_fn, verify_fn = FAMILIES[family](model_cfg)
         hit = (
             init,
             jax.jit(functools.partial(prefill_fn, cfg=model_cfg)),
             jax.jit(functools.partial(decode_fn, cfg=model_cfg)),
+            jax.jit(functools.partial(verify_fn, cfg=model_cfg)),
         )
         _jit_cache[key] = hit
     return hit
@@ -90,7 +97,9 @@ class DecodeFns:
             )
         self.family = family
         self.model_cfg = model_cfg
-        self.init, self._prefill, self._decode = _jitted(family, model_cfg)
+        self.init, self._prefill, self._decode, self._verify = _jitted(
+            family, model_cfg
+        )
         self._signatures: set[tuple] = set()
         # called with (kind, tokens_shape, tables_shape) the first time
         # THIS instance sees a signature — the engine hangs its
@@ -140,6 +149,21 @@ class DecodeFns:
         return self._decode(
             params, cache_k, cache_v, tokens, positions, block_tables,
             sample=sample,
+        )
+
+    def verify(self, params, cache_k, cache_v, tokens, starts, draft_len,
+               block_tables, sample=None):
+        # speculative-decoding verify window: tokens [B, W] with W fixed
+        # per engine at speculative_k + 1 (per-row draft availability is
+        # DATA — draft_len — not shape), so the signature set adds exactly
+        # ("verify",) x batch_buckets x tables-width and stays frozen
+        # under mixed speculative/plain traffic.
+        self._note(
+            ("verify", tuple(tokens.shape), tuple(block_tables.shape))
+        )
+        return self._verify(
+            params, cache_k, cache_v, tokens, starts, draft_len,
+            block_tables, sample=sample,
         )
 
     @property

@@ -178,6 +178,24 @@ class EngineConfig:
     # (prefill, prefill_chunk, decode) x buckets, and token streams are
     # byte-identical across backends (tests/test_paged_attention.py).
     attention_backend: str | None = None
+    # ---- speculative decoding (drafter.py + executor.verify_step) ----
+    # speculative_k > 0 turns on draft-and-verify: a host-side Drafter
+    # proposes up to k tokens per sequence and the target model scores
+    # the whole [B, k+1] window in ONE jitted "verify" call, committing
+    # an accepted prefix plus one corrected token per step (1..k+1
+    # tokens). LOSSLESS by construction: acceptance is exact-match
+    # against the keyed (seed, position) sampler, so streams are
+    # byte-identical to speculative_k=0 for greedy AND temperature/
+    # top-k/top-p (docs/SERVING_LLM.md "Speculative decoding"). The
+    # window width k+1 is frozen per engine — per-row draft availability
+    # is data, not shape — so speculation adds exactly one compile kind
+    # ("verify") x the existing buckets.
+    speculative_k: int = 0
+    # Drafter | "ngram" | None. "ngram" = the model-free prompt-lookup
+    # drafter (drafter.NGramDrafter); None drafts nothing (every
+    # speculative step degenerates to a 1-token verify). Only consulted
+    # when speculative_k > 0.
+    drafter: Any = "ngram"
 
 
 class TokenStream:
@@ -350,6 +368,19 @@ class LLMEngine:
         self.executor = build_executor(
             cfg, model_cfg, self.cache, params=params
         )
+        # speculative decoding: host-side drafter + acceptance accounting
+        if cfg.speculative_k < 0:
+            raise ValueError("speculative_k must be >= 0")
+        if cfg.speculative_k > 0:
+            from ray_tpu.serve.llm.drafter import build_drafter
+
+            self._drafter = build_drafter(cfg.drafter)
+        else:
+            self._drafter = None
+        self._spec_steps = 0            # verify steps run
+        self._spec_drafted_total = 0    # draft tokens proposed
+        self._spec_accepted_total = 0   # draft tokens accepted by verify
+        self._spec_committed_total = 0  # tokens emitted by verify steps
         self._batch_buckets = cfg.batch_buckets or pow2_buckets(
             1, cfg.max_batch_size
         )
@@ -458,6 +489,19 @@ class LLMEngine:
         self._m_prefill_tokens = metrics.counter(
             "llm_prefill_tokens",
             "Prompt tokens actually computed by prefill (cache misses)",
+        )
+        self._m_spec_drafted = metrics.counter(
+            "llm_spec_drafted_tokens",
+            "Draft tokens proposed to speculative verify steps",
+        )
+        self._m_spec_accepted = metrics.counter(
+            "llm_spec_accepted_tokens",
+            "Draft tokens accepted by speculative verify steps",
+        )
+        self._m_spec_committed = metrics.counter(
+            "llm_spec_committed_tokens",
+            "Tokens committed by speculative verify steps (accepted + "
+            "corrected/bonus)",
         )
         self._m_ttft = obs.ttft_histogram()
         self._m_tpot = obs.tpot_histogram()
@@ -647,6 +691,17 @@ class LLMEngine:
                 ),
                 "host_sync_bytes_total": self._sync_bytes_total,
                 "decode_inflight": 1 if self._pending is not None else 0,
+                "spec_steps": self._spec_steps,
+                "spec_drafted_tokens": self._spec_drafted_total,
+                "spec_accepted_tokens": self._spec_accepted_total,
+                "spec_committed_tokens": self._spec_committed_total,
+                "spec_accept_rate": (
+                    self._spec_accepted_total
+                    / max(1, self._spec_drafted_total)
+                ),
+                "spec_committed_per_step": (
+                    self._spec_committed_total / max(1, self._spec_steps)
+                ),
                 "executor": self.executor.describe(),
                 "failed": self._failed is not None,
             }
@@ -1061,10 +1116,32 @@ class LLMEngine:
             ]
 
         batch = eligible()
+        emitted = 0
+        # ---- speculative draft-and-verify (cfg.speculative_k > 0) ----
+        # Drafting needs the rows' COMMITTED tokens on host, so a verify
+        # step can never be dispatched ahead: when any row has drafts,
+        # collapse the lag-1 pending first, re-draft on the reconciled
+        # state, and run ONE synchronous verify step committing 1..k+1
+        # tokens per row. When no row drafts anything, fall through to
+        # the plain pipelined decode below — drafter-hostile traffic
+        # keeps the lag-1 dispatch-ahead path untouched.
+        if self._drafter is not None and batch:
+            proposals = self._propose_drafts_locked(batch)
+            if proposals is not None:
+                if pending is not None:
+                    emitted += self._reconcile_locked(pending)
+                    pending = None
+                    batch = eligible()
+                    proposals = (
+                        self._propose_drafts_locked(batch) if batch else None
+                    )
+                if batch and proposals is not None:
+                    self._verify_locked(batch, proposals, t0, t0_wall,
+                                        emitted)
+                    return
         # list equality is element identity here: same _Request objects
         # in the same order <=> nothing joined/finished/evicted
         steady = pending is not None and batch == pending.batch
-        emitted = 0
         if pending is not None and not steady:
             emitted += self._reconcile_locked(pending)
             pending = None
@@ -1094,8 +1171,16 @@ class LLMEngine:
             pairs.extend(cow)
         self._apply_copies_locked(pairs)
         B = pad_to_bucket(len(batch), self._batch_buckets)
+        # a row can HOLD blocks past its committed frontier (a verify
+        # step whose drafts were rejected appended them; they're reused
+        # as the frontier advances) — the table must span what's held,
+        # not just what's committed
         ctx = pad_to_bucket(
-            max(r.total_len + r.inflight for r in batch),
+            max(
+                max(r.total_len + r.inflight,
+                    self.cache.num_allocated(r.id) * bs)
+                for r in batch
+            ),
             self._length_buckets,
         )
         nb = ctx // bs
@@ -1164,6 +1249,155 @@ class LLMEngine:
             emitted += 1
         self._running = [r for r in self._running if not r.done]
         return emitted
+
+    def _propose_drafts_locked(self, batch: list) -> list[list[int]] | None:
+        """Ask the drafter for up to ``speculative_k`` candidate tokens
+        per row. Per-row draft length is clamped to the row's remaining
+        token budget minus one — so committed tokens (accepted prefix +
+        one corrected/bonus) can never exceed ``max_new_tokens``, which
+        also keeps every speculative KV write inside the row's worst-case
+        block reservation. Out-of-vocab proposals truncate the draft (a
+        drafter is a performance hint, never a correctness input).
+        Returns None when no row drafted anything."""
+        k = self.cfg.speculative_k
+        V = self.model_cfg.vocab_size
+        out: list[list[int]] = []
+        any_draft = False
+        for r in batch:
+            k_eff = min(
+                k,
+                r.sampling.max_new_tokens - len(r.generated)
+                - r.inflight - 1,
+            )
+            clean: list[int] = []
+            if k_eff > 0:
+                for t in self._drafter.propose(
+                    r.prompt, r.generated, k_eff
+                ):
+                    t = int(t)
+                    if not 0 <= t < V or len(clean) >= k_eff:
+                        break
+                    clean.append(t)
+            out.append(clean)
+            any_draft = any_draft or bool(clean)
+        return out if any_draft else None
+
+    def _verify_locked(self, batch: list, proposals: list[list[int]],
+                       t0: float, t0_wall: float, emitted: int) -> None:
+        """One synchronous speculative verify step over ``batch``: stage
+        the [B, W] window (column 0 = each row's last committed token —
+        exactly what a plain decode step would feed — then its drafts;
+        W = speculative_k + 1 FROZEN per engine so the signature set
+        stays closed under mixed traffic), run the jitted verify, sync
+        the packed [B, W+1] verdicts (lag 0 — the next window's drafts
+        need these tokens on host), and emit 1..draft_len+1 committed
+        tokens per row. EOS landing mid-window stops that row's emission
+        on the spot; the remaining verdicts are dead and its blocks
+        release exactly once through the normal completion path
+        (``inflight`` is 0 here — verify never runs under the lag)."""
+        bs = self.cfg.block_size
+        W = self.cfg.speculative_k + 1
+        draft_lens = [len(p) for p in proposals]
+        pairs: list[tuple[int, int]] = []
+        for r, dl in zip(batch, draft_lens):
+            # the window writes K/V at positions total_len-1 ..
+            # total_len-1+dl (committed column + live draft columns;
+            # padding columns redirect to the garbage block, so the
+            # reservation only covers the clamped draft length)
+            eff = r.total_len + dl
+            appended = self.cache.ensure_capacity(r.id, eff)
+            r.drawn_blocks += appended
+            cow = self.cache.prepare_write(r.id, r.total_len - 1, eff)
+            r.drawn_blocks += len(cow)
+            pairs.extend(cow)
+        self._apply_copies_locked(pairs)
+        B = pad_to_bucket(len(batch), self._batch_buckets)
+        # span what each row HOLDS, not just this window: an earlier
+        # rejected window may have appended blocks past today's eff
+        ctx = pad_to_bucket(
+            max(
+                max(r.total_len + dl,
+                    self.cache.num_allocated(r.id) * bs)
+                for r, dl in zip(batch, draft_lens)
+            ),
+            self._length_buckets,
+        )
+        nb = ctx // bs
+        tokens = self._scratch_buf("vf_tokens", (B, W), np.int32)
+        starts = self._scratch_buf("vf_starts", (B,), np.int32)
+        dlen = self._scratch_buf("vf_dlen", (B,), np.int32)
+        tables = self._scratch_buf("vf_tables", (B, nb), np.int32)
+        # reused buffers: re-zero padding (a stale table row could point
+        # at blocks now owned by a live sequence)
+        tokens[len(batch):] = 0
+        starts[len(batch):] = 0
+        dlen[len(batch):] = 0
+        tables[len(batch):] = 0
+        for i, (r, props) in enumerate(zip(batch, proposals)):
+            tokens[i, 0] = r.generated[-1] if r.generated else r.prompt[-1]
+            tokens[i, 1:1 + len(props)] = props
+            tokens[i, 1 + len(props):] = 0
+            starts[i] = r.total_len - 1
+            dlen[i] = len(props)
+            tables[i] = self._table_for(r, nb)
+        packed_dev = self.executor.verify_step(
+            tokens, starts, dlen, tables,
+            sample=self._sample_args_locked(batch, B),
+        )
+        packed = self._sync_verify_locked(packed_dev)
+        # a completed sync proves every earlier dispatch executed
+        self.cache.flush_quarantine()
+        drafted = sum(draft_lens)
+        accepted = 0
+        step_tokens = 0
+        for i, (r, dl) in enumerate(zip(batch, draft_lens)):
+            # device contract: 1 <= committed <= draft_len + 1; clamp
+            # anyway so a bad verdict can never overrun the budget
+            committed = max(1, min(int(packed[i, 0]), dl + 1))
+            accepted += committed - 1
+            for j in range(committed):
+                self._emit_token_locked(r, int(packed[i, 1 + j]))
+                step_tokens += 1
+                if r.done:
+                    break
+        self._running = [r for r in self._running if not r.done]
+        self._spec_steps += 1
+        self._spec_drafted_total += drafted
+        self._spec_accepted_total += accepted
+        self._spec_committed_total += step_tokens
+        if drafted:
+            self._m_spec_drafted.inc(drafted)
+        if accepted:
+            self._m_spec_accepted.inc(accepted)
+        self._m_spec_committed.inc(step_tokens)
+        dt = obs.clock() - t0
+        self._m_util.set(self.cache.utilization)
+        self._sync_cache_counters_locked()
+        self._m_latency.observe(dt, tags={"kind": "verify"})
+        event_stats.record("llm.engine.step.verify", dt)
+        self._flight_record_locked(
+            "verify", t0_wall, dt, batch=len(batch), bucket_b=B,
+            bucket_len=ctx, nb=nb, window=W, drafted=drafted,
+            accepted=accepted, tokens=emitted + step_tokens,
+        )
+
+    def _sync_verify_locked(self, packed_dev) -> np.ndarray:
+        """The verify-step host sync: one packed [B, W+1] int32 array
+        through the same blessed channel (executor.sync_verify ->
+        _host_tokens), timed and metered exactly like the token sync."""
+        t0 = obs.clock()
+        packed = self.executor.sync_verify(packed_dev)
+        dt = obs.clock() - t0
+        self._m_sync.observe(dt)
+        self._m_sync_bytes.inc(packed.nbytes)
+        self._sync_seconds_total += dt
+        self._sync_bytes_total += packed.nbytes
+        self._last_sync = {
+            "sync_ms": round(dt * 1000.0, 3),
+            "sync_bytes": int(packed.nbytes),
+            "sync_lag": 0,
+        }
+        return packed
 
     def _sync_tokens_locked(self, tokens_dev, *, lag: int) -> np.ndarray:
         """THE device->host sync: O(batch) int32 token ids, timed and

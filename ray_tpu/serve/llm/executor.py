@@ -48,7 +48,9 @@ logger = logging.getLogger("ray_tpu.serve.llm")
 
 def _host_tokens(tokens) -> np.ndarray:
     """The ONE device->host sync point on the emit path: materialize a
-    step's sampled token ids as O(batch) int32 numpy. All other serve/llm
+    step's sampled token ids as O(batch) int32 numpy — [B] for plain
+    decode/prefill, [B, W+1] packed verdicts for a speculative verify
+    step (still O(batch * k) int32, never logits). All other serve/llm
     code must stay on-device (tests/test_sanitizers.py lints this) —
     for every executor, sharded included."""
     return np.asarray(tokens, np.int32)
@@ -76,12 +78,21 @@ class ModelExecutor:
     - ``decode_step(tokens, positions, tables, sample=)`` — one decode
       step; ``tokens`` is either a host staging array (cold dispatch) or
       the previous step's on-device array (the lag-1 steady feed).
+    - ``verify_step(tokens, starts, draft_len, tables, sample=)`` — one
+      speculative draft-and-verify step over a [B, W] window (column 0 =
+      last committed token, then drafts); returns on-device packed
+      [B, W+1] verdicts (ops/sampling.py ``verify_tokens``).
     - ``copy_blocks(pairs)`` — fused on-device COW block copies.
     - ``sync_tokens(tokens_dev)`` — THE O(batch) int32 host sync.
+    - ``sync_verify(packed_dev)`` — the same sync point for a verify
+      step's packed verdicts ([B, W+1] int32 through ``_host_tokens``).
     - ``on_new_signature`` — compile-event hook, forwarded to DecodeFns.
     """
 
     kind = "single"
+    # set by build_executor from EngineConfig when speculation is on;
+    # surfaced via describe() -> stats()/debug_dump()
+    speculative: dict | None = None
 
     def __init__(self, family: str, model_cfg, cache, *,
                  params: dict | None = None, seed: int = 0):
@@ -157,6 +168,14 @@ class ModelExecutor:
         )
         return toks
 
+    def verify_step(self, tokens, starts, draft_len, tables, sample=None):
+        out, self.cache.k, self.cache.v = self.fns.verify(
+            self.params, self.cache.k, self.cache.v,
+            self._dev(tokens), self._dev(starts), self._dev(draft_len),
+            self._dev(tables), sample=self._dev_sample(sample),
+        )
+        return out
+
     def copy_blocks(self, pairs: list[tuple[int, int]]) -> None:
         """Clone shared KV blocks on device (COW) before a write lands.
         The (src, dst) list pads to a pow2 bucket with (0, 0) — copying
@@ -189,6 +208,18 @@ class ModelExecutor:
         )
         return toks
 
+    def sync_verify(self, packed_dev) -> np.ndarray:
+        """The SAME host sync point for a speculative verify step: one
+        packed [B, W+1] int32 array (committed count + the window's
+        target tokens) — O(batch * (k+2)) int32, still no logits and
+        still exactly one transfer per step."""
+        packed = _host_tokens(packed_dev)
+        assert packed.dtype == np.int32 and packed.ndim == 2, (
+            "verify sync path must move O(batch * k) int32, got "
+            f"{packed.dtype}/{packed.shape}"
+        )
+        return packed
+
     # ---------------- introspection ----------------
 
     @property
@@ -212,7 +243,8 @@ class ModelExecutor:
         attention backend the model steps compiled with."""
         return {"executor": self.kind, "devices": self.num_devices,
                 "mesh": None,
-                "attention_backend": self.attention_backend}
+                "attention_backend": self.attention_backend,
+                "speculative": self.speculative}
 
 
 class SingleDeviceExecutor(ModelExecutor):
@@ -340,6 +372,7 @@ class ShardedExecutor(ModelExecutor):
             "mesh": {a: int(s) for a, s in self.mesh.shape.items()
                      if int(s) > 1},
             "attention_backend": self.attention_backend,
+            "speculative": self.speculative,
         }
 
 
@@ -348,10 +381,21 @@ def build_executor(cfg, model_cfg, cache, *, params=None) -> ModelExecutor:
     mesh (``mesh=``) or widens an axis (``tp``/``fsdp`` > 1) — the
     default path constructs byte-for-byte the pre-seam engine."""
     if cfg.mesh is None and cfg.tp == 1 and cfg.fsdp == 1:
-        return SingleDeviceExecutor(
+        ex = SingleDeviceExecutor(
             cfg.model, model_cfg, cache, params=params, seed=cfg.seed
         )
-    return ShardedExecutor(
-        cfg.model, model_cfg, cache, mesh=cfg.mesh, tp=cfg.tp,
-        fsdp=cfg.fsdp, params=params, seed=cfg.seed,
-    )
+    else:
+        ex = ShardedExecutor(
+            cfg.model, model_cfg, cache, mesh=cfg.mesh, tp=cfg.tp,
+            fsdp=cfg.fsdp, params=params, seed=cfg.seed,
+        )
+    k = int(getattr(cfg, "speculative_k", 0) or 0)
+    if k > 0:
+        drafter = getattr(cfg, "drafter", None)
+        ex.speculative = {
+            "speculative_k": k,
+            "drafter": (drafter if isinstance(drafter, str)
+                        else type(drafter).__name__ if drafter is not None
+                        else None),
+        }
+    return ex

@@ -12,6 +12,20 @@ import os
 
 # Must be set before the first jax backend initialization.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Persistent XLA compilation cache, shared across test processes AND the
+# worker/replica subprocesses they spawn (env vars inherit; config calls
+# would not). The suite compiles the same tiny models dozens of times —
+# every serve-cluster fixture pays the full jit chain per replica process —
+# and the tier-1 wall-clock budget is tight enough that those duplicate
+# compiles matter. Keyed by jax version + backend + program hash, so hits
+# return byte-identical executables; thresholds are zeroed because the
+# tiny-model compiles this suite repeats are individually sub-second.
+_cache_dir = os.path.join(
+    os.environ.get("TMPDIR", "/tmp"), "ray_tpu_jax_test_cache"
+)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 # Strict wire-schema validation (schema.py): GCS rejects malformed payloads
 # in tests so message drift fails loudly at the RPC boundary.
 os.environ.setdefault("RAY_TPU_STRICT_SCHEMA", "1")

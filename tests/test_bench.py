@@ -79,3 +79,20 @@ def test_gpt_bench_grows_positional_table_for_long_seq(jax_cpu):
                            steps=2, warmup=1, chunk=2)
     assert result["seq_len"] == 256  # tiny max_seq_len is 128
     assert result["value"] > 0
+
+
+def test_paged_attn_shape_env_override(monkeypatch):
+    """The paged-attention microbench shape is env-overridable: a valid
+    RAY_TPU_PAGED_ATTN_SHAPE parses (',' or 'x' separated), unset means
+    None (fall back to the baked-in shape), malformed fails loudly."""
+    from ray_tpu.benchmarks import llm_serving
+
+    monkeypatch.delenv("RAY_TPU_PAGED_ATTN_SHAPE", raising=False)
+    assert llm_serving._paged_attn_env_shape() is None
+    monkeypatch.setenv("RAY_TPU_PAGED_ATTN_SHAPE", "4,8,2,32")
+    assert llm_serving._paged_attn_env_shape() == (4, 8, 2, 32)
+    monkeypatch.setenv("RAY_TPU_PAGED_ATTN_SHAPE", "4x8x2x32")
+    assert llm_serving._paged_attn_env_shape() == (4, 8, 2, 32)
+    monkeypatch.setenv("RAY_TPU_PAGED_ATTN_SHAPE", "4,8")
+    with pytest.raises(ValueError):
+        llm_serving._paged_attn_env_shape()

@@ -131,7 +131,11 @@ def test_device_values_cross_host_only_in_host_tokens():
     is a hidden device sync (or a smuggled O(vocab) transfer) in the
     scheduler hot loop, and under the dispatch-ahead pipeline a stray
     sync also collapses the lag — under a sharded executor it would
-    additionally serialize every chip in the mesh. Allowlist:
+    additionally serialize every chip in the mesh. The speculative path
+    (ISSUE 9) is held to the same bar: the drafter proposes from host
+    Python ints it already has (``drafter.py`` must stay device-free)
+    and the verify step's packed verdicts come back through the same
+    ``_host_tokens`` funnel (``executor.sync_verify``). Allowlist:
     ``_host_tokens`` (THE sync point) and kv_cache's ``_block_key``
     (hashes host-side Python int lists — never touches a device
     value)."""
@@ -145,6 +149,12 @@ def test_device_values_cross_host_only_in_host_tokens():
     # lint targets — it owns the device<->host boundary now
     assert any(p.name == "executor.py" for p in targets), (
         "executor.py missing from serve/llm lint targets"
+    )
+    # the speculative-decoding drafter must be covered too: it runs in
+    # the scheduler hot loop before every decode dispatch, so a device
+    # pull (or even a numpy materialization) there stalls every step
+    assert any(p.name == "drafter.py" for p in targets), (
+        "drafter.py missing from serve/llm lint targets"
     )
     allowed = {("executor.py", "_host_tokens"), ("kv_cache.py", "_block_key")}
 
