@@ -14,8 +14,15 @@ Hook sites currently instrumented:
   ``engine.decode``   — before a batched decode call
   ``llm.token``       — after LLMDeployment yields one streamed chunk
                         (context: index, resumed, tag)
+  ``llm.snapshot``    — before LLMDeployment reports an autoscaling
+                        snapshot (delay here simulates a slow/jittery
+                        control plane without touching the data plane)
   ``handle.dispatch`` — before the router dispatches a call to a replica
                         (context: method)
+  ``replica_drain``   — when a replica enters DRAINING
+                        (context: active — in-flight stream count)
+  ``controller_scale``— before the controller applies a replica-count
+                        change (context: app, deployment, current, target)
 
 Plans install either in-process (``install``, for unit tests driving an
 engine directly) or via the ``RAY_TPU_CHAOS_PLAN`` environment variable

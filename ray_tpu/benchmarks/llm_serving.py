@@ -65,6 +65,21 @@ Reported (one JSON line, merged into bench.py's aux results under
                               GQA-heavy point reports under
                               ``llm_paged_attn_gqa_*``
 
+- ``llm_load_ttft_p99_ms`` / ``llm_load_tpot_p99_ms`` /
+  ``llm_load_shed_rate``     the chaos load harness (``run_load_bench``):
+                              seeded open-loop bursty traffic against a
+                              LIVE multi-replica cluster while a chaos
+                              kill, a graceful drain (scale_deployment),
+                              and a signal-driven autoscale event land
+                              mid-burst — tail latency under failures
+                              plus the fraction of requests shed by
+                              cluster-wide admission control;
+                              ``llm_load_lossless`` asserts every
+                              accepted stream matched an unfaulted
+                              local reference byte-for-byte (zero
+                              dropped or duplicated tokens through
+                              kill + drain)
+
 Runs on CPU with the tiny llama config — the point is tracking the
 scheduler/cache overheads and the hit-rate plumbing release-over-release,
 not absolute TPU throughput (bench.py GPT-MFU owns that axis).
@@ -99,6 +114,16 @@ PAGED_ATTN_ITERS = 20
 # the n-gram drafter locks onto the repeating motif within the run
 SPEC_K = 4
 SPEC_NEW_TOKENS = 48
+# chaos load harness: seeded open-loop bursty traffic over a live cluster
+# with a mid-stream replica kill, a graceful drain, and a signal-driven
+# autoscale event. Burst sizes are skewed (the first is the heaviest) and
+# gaps are long enough for replica startup to land inside the run.
+LOAD_SEED = 11
+LOAD_BURSTS = (10, 8, 6)
+LOAD_BURST_GAP_S = 6.0
+LOAD_DRAIN_AT_S = 11.0   # scale_deployment -> 1 (graceful drain) offset
+LOAD_NEW_TOKENS = 12
+LOAD_KILL_INDEX = 2      # chunk index after which the tagged replica dies
 
 
 def _ensure_virtual_devices(n: int) -> None:
@@ -492,6 +517,263 @@ def run_spec_decode_bench() -> dict:
     }
 
 
+def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
+    """Seeded open-loop request schedule: (index, start offset s, payload)
+    per request. Skewed prompt lengths (zipf) and bursty arrivals; the
+    first request of the SECOND burst carries the chaos kill tag so the
+    kill lands while both the heavy first burst's stragglers and fresh
+    work are in flight."""
+    requests = []
+    base = 0.0
+    idx = 0
+    for size in LOAD_BURSTS:
+        for _ in range(size):
+            n = int(min(3 + rng.zipf(1.8), 24))
+            payload = {
+                "prompt": [int(x) for x in rng.integers(1, vocab_size, n)],
+                "request_id": f"load-{idx}",
+                "max_new_tokens": LOAD_NEW_TOKENS,
+                "temperature": 0.8,
+                "seed": 1000 + idx,
+            }
+            requests.append((idx, base + float(rng.random() * 0.5), payload))
+            idx += 1
+        base += LOAD_BURST_GAP_S
+    requests[LOAD_BURSTS[0]][2]["chaos_tag"] = "loadkill"
+    return requests
+
+
+def run_load_bench() -> dict:
+    """Multi-replica chaos load harness: open-loop seeded bursty traffic
+    through a kill + graceful drain + signal-driven autoscale event.
+
+    Storyline (all inside one ~20 s traffic window):
+      1. the app starts at min_replicas=1; the heavy first burst trips
+         the queue-wait signal and the controller scales up,
+      2. the second burst's tagged request kills its serving replica
+         mid-stream (chaos ``llm.token`` kill) — its stream and every
+         sibling on that replica fail over byte-identically,
+      3. at ``LOAD_DRAIN_AT_S`` the harness calls ``scale_deployment``
+         down — a graceful drain — while the third burst re-heats the
+         fleet (and may scale it back up through the same drain).
+
+    Accepted streams are compared byte-for-byte against an unfaulted
+    local reference engine; requests shed by cluster-wide admission
+    (EngineOverloadedError at dispatch) count toward
+    ``llm_load_shed_rate`` and nothing else."""
+    import dataclasses
+    import threading
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import Fault, FaultPlan
+    from ray_tpu.exceptions import EngineOverloadedError
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_llm_app, stream_tokens
+
+    plan = FaultPlan(seed=LOAD_SEED, faults=(
+        Fault(point="llm.token", action="kill",
+              when={"tag": "loadkill", "index": LOAD_KILL_INDEX,
+                    "resumed": False}),
+    ))
+    prev_plan = os.environ.get(chaos.ENV_VAR)
+    os.environ[chaos.ENV_VAR] = plan.to_json()
+    chaos.clear()
+
+    # float32 + xla attention: bitwise-reproducible across replicas and
+    # the local reference engine (same seed -> same weights)
+    mc = dataclasses.replace(
+        LlamaConfig.tiny(), dtype=jnp.float32, attention="xla")
+    ecfg = EngineConfig(model="llama", model_config=mc, seed=0)
+    rng = np.random.default_rng(LOAD_SEED)
+    requests = _load_schedule(rng, mc.vocab_size)
+
+    results: list[dict] = []
+    results_lock = threading.Lock()
+    status_samples: list[dict] = []
+    stop = threading.Event()
+
+    def worker(idx, start_at, payload, handle, t0):
+        delay = start_at - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        rec = {"i": idx, "payload": payload, "shed": False, "error": None,
+               "chunks": [], "arrivals": [],
+               "dispatched": time.perf_counter(), "failovers": 0}
+        while True:
+            gen = stream_tokens(handle, payload)
+            try:
+                for chunk in gen:
+                    rec["arrivals"].append(time.perf_counter())
+                    rec["chunks"].append(chunk)
+            except Exception as e:  # noqa: BLE001 — shed vs real error
+                from ray_tpu.exceptions import TaskError
+
+                cause = e.cause if isinstance(e, TaskError) and e.cause else e
+                if isinstance(cause, EngineOverloadedError):
+                    # the tagged request anchors the chaos kill: it must
+                    # actually stream, so it rides out shed windows
+                    # (open-loop clients don't retry; this one is the
+                    # fault injector, not a latency sample)
+                    if ("chaos_tag" in payload
+                            and time.perf_counter() - t0 < 90.0):
+                        rec["chunks"].clear()
+                        rec["arrivals"].clear()
+                        time.sleep(0.25)
+                        rec["dispatched"] = time.perf_counter()
+                        continue
+                    rec["shed"] = True  # router shed or admission reject
+                else:
+                    rec["error"] = repr(e)
+            rec["failovers"] = gen.failovers
+            break
+        with results_lock:
+            results.append(rec)
+
+    ray_tpu.init(num_cpus=8)
+    try:
+        handle = serve.run(
+            build_llm_app(
+                ecfg,
+                autoscaling_config=dict(
+                    min_replicas=1, max_replicas=2,
+                    # CPU tiny-model queue waits are short; lower the trip
+                    # point so the first burst reliably reads as HOT
+                    upscale_queue_wait_p95_s=0.05,
+                    upscale_delay_periods=1,
+                    # never scale down on policy mid-bench — the one
+                    # scale-down is the harness's explicit drain event
+                    downscale_delay_periods=10_000,
+                ),
+            ),
+            name="llm-load", timeout_s=300,
+        )
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+
+        def sampler():
+            while not stop.is_set():
+                try:
+                    st = ray_tpu.get(ctrl.status.remote(), timeout=10)
+                    d = st.get("llm-load", {}).get("LLMDeployment")
+                    if d:
+                        status_samples.append(d)
+                except Exception:  # noqa: BLE001 — controller busy; skip
+                    pass
+                stop.wait(0.2)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(
+                target=worker, args=(i, at, p, handle, t0), daemon=True)
+            for i, at, p in requests
+        ]
+        sam = threading.Thread(target=sampler, daemon=True)
+        sam.start()
+        for th in threads:
+            th.start()
+
+        def _dep():
+            st = ray_tpu.get(ctrl.status.remote(), timeout=10)
+            return st.get("llm-load", {}).get("LLMDeployment") or {}
+
+        def drainer():
+            delay = LOAD_DRAIN_AT_S - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            # drain a RUNNING replica: right after the chaos kill the
+            # replacement may still be STARTING, and draining a STARTING
+            # replica is a plain kill — wait out the restart first
+            deadline = time.perf_counter() + 60.0
+            while time.perf_counter() < deadline:
+                try:
+                    if _dep().get("running_replicas") == 2:
+                        break
+                except Exception:  # noqa: BLE001 — controller busy
+                    pass
+                time.sleep(0.2)
+            ray_tpu.get(ctrl.scale_deployment.remote(
+                "llm-load", "LLMDeployment", 1), timeout=30)
+            # an idle drain resolves faster than the sampler's 0.2 s
+            # cadence — sample tightly until DRAINING (or done) is seen
+            for _ in range(200):
+                try:
+                    d = _dep()
+                    if d:
+                        status_samples.append(d)
+                        if (d.get("draining_replicas", 0) > 0
+                                or d.get("running_replicas") == 1):
+                            break
+                except Exception:  # noqa: BLE001 — controller busy
+                    pass
+                time.sleep(0.02)
+
+        dr = threading.Thread(target=drainer, daemon=True)
+        dr.start()
+        for th in threads:
+            th.join(timeout=300)
+        dr.join(timeout=60)
+        stop.set()
+        sam.join(timeout=10)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        chaos.clear()
+        if prev_plan is None:
+            os.environ.pop(chaos.ENV_VAR, None)
+        else:
+            os.environ[chaos.ENV_VAR] = prev_plan
+
+    # -- byte-identity vs an unfaulted single-engine reference --
+    ref_eng = LLMEngine(ecfg, auto_step=False)
+    lossless = True
+    accepted = [r for r in results if not r["shed"] and r["error"] is None]
+    for rec in accepted:
+        p = rec["payload"]
+        ref = ref_eng.generate(
+            p["prompt"], max_new_tokens=p["max_new_tokens"],
+            temperature=p["temperature"], seed=p["seed"],
+        )
+        idxs = [c["index"] for c in rec["chunks"]]
+        toks = [c["token"] for c in rec["chunks"]]
+        if idxs != list(range(len(idxs))) or toks != ref:
+            lossless = False
+    ref_eng.shutdown()
+
+    total = len(results)
+    shed = sum(1 for r in results if r["shed"])
+    errors = sum(1 for r in results if r["error"] is not None)
+    ttfts = [r["arrivals"][0] - r["dispatched"]
+             for r in accepted if r["arrivals"]]
+    tpots: list[float] = []
+    for r in accepted:
+        tpots.extend(np.diff(r["arrivals"]))
+    targets = [s["target_replicas"] for s in status_samples]
+    scale_events = sum(1 for a, b in zip(targets, targets[1:]) if a != b)
+    return {
+        "llm_load_requests": total,
+        "llm_load_completed": len(accepted),
+        "llm_load_errors": errors,
+        "llm_load_shed_rate": round(shed / max(total, 1), 4),
+        "llm_load_ttft_p99_ms": round(
+            float(np.percentile(ttfts, 99)) * 1e3, 3) if ttfts else None,
+        "llm_load_tpot_p99_ms": round(
+            float(np.percentile(tpots, 99)) * 1e3, 3) if tpots else None,
+        "llm_load_lossless": lossless and errors == 0,
+        "llm_load_failovers": sum(r["failovers"] for r in results),
+        "llm_load_scale_events": scale_events,
+        "llm_load_max_replicas": max(
+            (s["running_replicas"] for s in status_samples), default=None),
+        "llm_load_drain_observed": any(
+            s["draining_replicas"] > 0 for s in status_samples),
+    }
+
+
 def main() -> None:
     _ensure_virtual_devices(SHARDED_DEVICES)
     out = run_serving_bench()
@@ -503,6 +785,8 @@ def main() -> None:
             PAGED_ATTN_GQA_SHAPE, prefix="llm_paged_attn_gqa"
         )
     )
+    # last: the load harness owns a full ray_tpu cluster lifecycle
+    out.update(run_load_bench())
     print(json.dumps({"llm_serving": out}), flush=True)
 
 

@@ -2,11 +2,21 @@
 
 Equivalent of the reference's serve autoscaling policy
 (reference: python/ray/serve/_private/autoscaling_policy.py:12
-calculate_desired_num_replicas, :78 smoothing/bounds).
+calculate_desired_num_replicas, :78 smoothing/bounds), extended with a
+signal-driven policy over `serve.llm` AutoscalingSnapshot dicts: the
+controller feeds per-replica engine saturation (queue-wait p95, KV-pool
+pressure, deadline-miss / rejection rates) instead of raw HTTP
+concurrency, which is what the SLO-aware serving literature asks for —
+model saturation, not request counts.
+
+One-clock rule (PR 4): any time read in this module or in the
+controller's aggregation path uses obs.clock/obs.wall — snapshot
+freshness is judged on the same monotonic clock the engine stamps.
 """
 from __future__ import annotations
 
 import math
+from typing import Mapping, Sequence
 
 from ray_tpu.serve.config import AutoscalingConfig
 
@@ -39,6 +49,77 @@ def calculate_desired_num_replicas(
     return max(config.min_replicas, min(config.max_replicas, desired))
 
 
+def snapshot_is_hot(config: AutoscalingConfig, snap: Mapping) -> bool:
+    """One replica's engine snapshot trips a scale-up threshold.
+
+    Hot means the engine itself is saturating: requests wait too long at
+    admission, the paged KV pool is nearly spent, deadlines are being
+    missed, or admission control is already rejecting.
+    """
+    if snap.get("queue_wait_p95_s", 0.0) >= config.upscale_queue_wait_p95_s:
+        return True
+    if snap.get("kv_pool_pressure", 0.0) >= config.upscale_kv_pressure:
+        return True
+    if snap.get("deadline_miss_rate", 0.0) > config.upscale_deadline_miss_rate:
+        return True
+    return snap.get("rejection_rate", 0.0) > 0.0
+
+
+def snapshot_is_cold(config: AutoscalingConfig, snap: Mapping) -> bool:
+    """One replica is fully idle: nothing queued, nothing decoding, and the
+    KV pool below the downscale pressure bound (LRU-cached prefix blocks
+    are reclaimable, so they don't count against coldness)."""
+    return (
+        snap.get("queue_depth", 0) == 0
+        and snap.get("running", 0) == 0
+        and snap.get("prefilling", 0) == 0
+        and snap.get("kv_pool_pressure", 0.0) <= config.downscale_kv_pressure
+    )
+
+
+def desired_from_signals(
+    config: AutoscalingConfig,
+    snapshots: Sequence[Mapping],
+    current_num_replicas: int,
+) -> int:
+    """Desired replicas from per-replica engine snapshots.
+
+    Any hot replica asks for one more; down only when *all* replicas are
+    cold. One step per decided period is deliberate: the decider's
+    delay-periods debounce sets the ramp rate, and asymmetric up/down
+    thresholds (hot is not the complement of cold) give hysteresis so a
+    bursty trace can't flap the fleet.
+    """
+    if not snapshots:
+        desired = current_num_replicas
+    elif any(snapshot_is_hot(config, s) for s in snapshots):
+        desired = current_num_replicas + 1
+    elif all(snapshot_is_cold(config, s) for s in snapshots):
+        desired = current_num_replicas - 1
+    else:
+        desired = current_num_replicas
+    return max(config.min_replicas, min(config.max_replicas, desired))
+
+
+def fleet_saturated(
+    config: AutoscalingConfig,
+    snapshots: Sequence[Mapping],
+    current_num_replicas: int,
+) -> bool:
+    """Cluster-wide admission: shed new work at the router when scaling
+    can't help — the fleet is at max_replicas and every replica is both
+    hot and already queueing. Requests admitted past this point would sit
+    in a waiting queue until the engine's own backpressure (or their
+    deadline) killed them; a 503 + Retry-After now is strictly kinder.
+    """
+    if current_num_replicas < config.max_replicas or not snapshots:
+        return False
+    return all(
+        snapshot_is_hot(config, s) and s.get("queue_depth", 0) > 0
+        for s in snapshots
+    )
+
+
 class AutoscalingDecider:
     """Debounces policy output: act only after N consecutive periods agree
     (reference: upscale_delay_s/downscale_delay_s)."""
@@ -49,10 +130,23 @@ class AutoscalingDecider:
         self._streak = 0
 
     def decide(self, total_ongoing: float, current: int) -> int:
+        """Request-count policy (generic deployments)."""
         desired = calculate_desired_num_replicas(self.config, total_ongoing, current)
+        return self._debounce(desired, current)
+
+    def decide_from_signals(self, snapshots: Sequence[Mapping], current: int) -> int:
+        """Engine-signal policy (serve.llm deployments)."""
+        desired = desired_from_signals(self.config, snapshots, current)
+        return self._debounce(desired, current)
+
+    def _debounce(self, desired: int, current: int) -> int:
         direction = (desired > current) - (desired < current)
         if direction == 0:
+            # A settled period breaks any pending streak entirely: clearing
+            # only _streak (and not _pending_direction) would let a later
+            # tick in the same direction inherit the stale direction state.
             self._streak = 0
+            self._pending_direction = 0
             return current
         if direction != self._pending_direction:
             self._pending_direction = direction
@@ -66,5 +160,6 @@ class AutoscalingDecider:
         )
         if self._streak >= needed:
             self._streak = 0
+            self._pending_direction = 0
             return desired
         return current

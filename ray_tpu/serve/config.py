@@ -27,12 +27,32 @@ class AutoscalingConfig:
     upscale_delay_periods: int = 1
     downscale_delay_periods: int = 3
 
+    # --- engine-signal thresholds (serve.llm AutoscalingSnapshot) ---
+    # A replica is HOT (scale up) when any of these trip; the fleet scales
+    # DOWN only when every replica is cold (no queued or running work and
+    # KV pressure below the downscale bound). Pressures are fractions of
+    # the usable KV pool in [0, 1].
+    upscale_queue_wait_p95_s: float = 0.25
+    upscale_kv_pressure: float = 0.85
+    # deadline misses per second above which a replica counts as hot; the
+    # default 0.0 means "any miss is a saturation signal"
+    upscale_deadline_miss_rate: float = 0.0
+    downscale_kv_pressure: float = 0.5
+    # snapshots older than this (on obs.clock) are ignored by aggregation
+    signal_ttl_s: float = 5.0
+
     def __post_init__(self):
         if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
             raise ValueError(
                 f"need 0 <= min_replicas <= max_replicas, got "
                 f"{self.min_replicas}/{self.max_replicas}"
             )
+        for name in ("upscale_kv_pressure", "downscale_kv_pressure"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.upscale_queue_wait_p95_s < 0 or self.upscale_deadline_miss_rate < 0:
+            raise ValueError("signal thresholds must be >= 0")
 
 
 @dataclass

@@ -14,26 +14,46 @@ import time
 from typing import Any
 
 import ray_tpu
+from ray_tpu._private import chaos
 from ray_tpu.actor import ActorClass
-from ray_tpu.serve.autoscaling_policy import AutoscalingDecider
+from ray_tpu.serve.autoscaling_policy import AutoscalingDecider, fleet_saturated
 from ray_tpu.serve.config import DeploymentConfig
+from ray_tpu.serve.llm import obs
 from ray_tpu.serve.replica import ReplicaActor
+from ray_tpu.util import metrics
 
 CONTROLLER_NAME = "RT_SERVE_CONTROLLER"
 _METRIC_TTL_S = 5.0
+# cadence of per-replica autoscaling_snapshot pulls (signal-capable
+# deployments only) and the patience per pull
+_SNAPSHOT_PERIOD_S = 0.5
+_SNAPSHOT_TIMEOUT_S = 30.0
+# extra actor method threads beyond max_ongoing_requests, so control-plane
+# calls (ping / autoscaling_snapshot / drain_status) never park behind a
+# data plane running at full concurrency — a saturated replica must still
+# report that it IS saturated
+_CONTROL_SLOTS = 3
 
 
 class _ReplicaState:
     def __init__(self, handle):
         self.handle = handle
         self.actor_id = handle._actor_id
-        self.state = "STARTING"  # STARTING | RUNNING | STOPPING
+        self.state = "STARTING"  # STARTING | RUNNING | DRAINING | STOPPING
         self.started_at = time.monotonic()
         self.ping_ref = None
         self.ping_deadline = 0.0
         self.next_ping_at = 0.0
         self.probe_ref = None  # in-flight batch_configs readiness probe
         self.probe_deadline = 0.0
+        # autoscaling_snapshot polling (obs.clock timeline — one-clock rule)
+        self.snapshot_ref = None
+        self.snapshot_deadline = 0.0
+        self.next_snapshot_at = 0.0
+        # graceful drain state machine (DRAINING replicas only)
+        self.drain_ref = None   # in-flight prepare_drain / drain_status poll
+        self.finish_ref = None  # in-flight finish_drain (release_all)
+        self.drain_deadline = 0.0
 
 
 # consecutive replica deaths before __rt first became RUNNING that flip the
@@ -59,6 +79,14 @@ class _DeploymentState:
         self.last_error: str | None = None
         self.consecutive_start_failures = 0
         self.deleted = False
+        # engine-signal autoscaling (set from replica_metadata capability
+        # flags once the first replica probes ready)
+        self.signal_capable = False
+        self.drain_capable = False
+        # actor_id bytes -> (obs.clock pull time, AutoscalingSnapshot dict)
+        self.snapshots: dict[bytes, tuple[float, dict]] = {}
+        # cluster-wide admission: routers shed new work while True
+        self.shed = False
 
 
 class _ProxyState:
@@ -93,6 +121,16 @@ class ServeController:
         self._proxy_failures: dict[bytes, int] = {}
         self._stopped = threading.Event()
         self._reconcile_period_s = reconcile_period_s
+        self._m_desired = metrics.gauge(
+            "llm_autoscale_desired_replicas",
+            "Autoscaler's current replica target per deployment",
+            tag_keys=("app", "deployment"),
+        )
+        self._m_draining = metrics.gauge(
+            "llm_replicas_draining",
+            "Replicas currently draining for graceful scale-down",
+            tag_keys=("app", "deployment"),
+        )
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconciler"
         )
@@ -122,6 +160,9 @@ class ServeController:
                         ds.replicas = prev.replicas  # adopt live replicas
                         ds.batch_configs = prev.batch_configs
                         ds.stream_methods = prev.stream_methods
+                        ds.signal_capable = prev.signal_capable
+                        ds.drain_capable = prev.drain_capable
+                        ds.snapshots = prev.snapshots
                         if prev.decider is not None and ds.decider is not None:
                             ds.decider = prev.decider
                     else:
@@ -167,7 +208,7 @@ class ServeController:
         if router_id is not None and metrics is not None:
             with self._lock:
                 self._router_metrics[router_id] = (
-                    time.monotonic(),
+                    obs.clock(),
                     {tuple(k): v for k, v in metrics.items()},
                 )
         out: dict[str, Any] = {"version": None, "apps": {}}
@@ -183,6 +224,11 @@ class ServeController:
                         "max_ongoing_requests": ds.config.max_ongoing_requests,
                         "batch_configs": ds.batch_configs,
                         "stream_methods": ds.stream_methods,
+                        # cluster-wide admission: routers raise
+                        # EngineOverloadedError pre-dispatch while set, so
+                        # doomed requests shed at the edge (503+Retry-After)
+                        # instead of queueing behind a saturated fleet
+                        "shed": ds.shed,
                     }
                 out["apps"][app_name] = {
                     "ingress": app["ingress"],
@@ -201,12 +247,36 @@ class ServeController:
                         "running_replicas": sum(
                             1 for r in ds.replicas if r.state == "RUNNING"
                         ),
+                        "draining_replicas": sum(
+                            1 for r in ds.replicas if r.state == "DRAINING"
+                        ),
+                        "shedding": ds.shed,
                         "message": ds.last_error or "",
                     }
                     for name, ds in app["deployments"].items()
                 }
                 for app_name, app in self._apps.items()
             }
+
+    def scale_deployment(
+        self, app_name: str, deployment_name: str, target: int
+    ) -> bool:
+        """Operator/test surface: set the replica target directly, clamped
+        to the autoscaling bounds when configured. Scale-downs go through
+        the same graceful drain as policy-driven ones. The chaos load
+        harness uses this to schedule a deterministic drain event."""
+        with self._lock:
+            app = self._apps.get(app_name)
+            ds = (app or {"deployments": {}})["deployments"].get(deployment_name)
+            if ds is None:
+                return False
+            target = int(target)
+            cfg = ds.config.autoscaling_config
+            if cfg is not None:
+                target = max(cfg.min_replicas, min(cfg.max_replicas, target))
+            ds.target = target
+            self._version += 1
+        return True
 
     def start_proxies(self, http_options: dict | None,
                       grpc_options: dict | None) -> None:
@@ -462,6 +532,10 @@ class ServeController:
                         with self._lock:
                             ds.batch_configs = meta["batch_configs"]
                             ds.stream_methods = meta["stream_methods"]
+                            ds.signal_capable = meta.get(
+                                "has_autoscaling_snapshot", False
+                            )
+                            ds.drain_capable = meta.get("has_drain", False)
                             r.state = "RUNNING"
                             ds.consecutive_start_failures = 0
                         changed = True
@@ -495,17 +569,47 @@ class ServeController:
                     )
                 return True
             return False
-        # 4. autoscaling decision from router-reported load
+        # 4. autoscaling decision — engine signals when the deployment
+        # exports AutoscalingSnapshot (serve.llm), router-reported
+        # in-flight load otherwise
         if ds.decider is not None:
-            total = self._aggregate_inflight(app_name, name)
+            self._poll_snapshots(ds)
             running = sum(1 for r in ds.replicas if r.state == "RUNNING")
-            if running > 0 or total > 0:
-                new_target = ds.decider.decide(total, ds.target)
-                if new_target != ds.target:
+            new_target = ds.target
+            if ds.signal_capable:
+                snaps = self._aggregate_signals(ds)
+                # decide only on a converged fleet with a full signal set:
+                # scaling while a replica warms (or with half the fleet's
+                # snapshots stale) would double-count the same saturation
+                if running == ds.target and len(snaps) == running and running > 0:
+                    new_target = ds.decider.decide_from_signals(snaps, ds.target)
+                shed = fleet_saturated(
+                    ds.config.autoscaling_config, snaps, ds.target
+                )
+                if shed != ds.shed:
                     with self._lock:
-                        ds.target = new_target
+                        ds.shed = shed
                     changed = True
-        # 5. converge replica count
+            else:
+                total = self._aggregate_inflight(app_name, name)
+                if running > 0 or total > 0:
+                    new_target = ds.decider.decide(total, ds.target)
+            if new_target != ds.target:
+                chaos.fire(
+                    "controller_scale",
+                    app=app_name,
+                    deployment=name,
+                    current=ds.target,
+                    target=new_target,
+                )
+                with self._lock:
+                    ds.target = new_target
+                changed = True
+            self._m_desired.set(
+                ds.target, tags={"app": app_name, "deployment": name}
+            )
+        # 5. converge replica count (scale-down drains gracefully when the
+        # deployment supports it), then advance in-flight drains
         with self._lock:
             live = [r for r in ds.replicas if r.state in ("STARTING", "RUNNING")]
             deficit = ds.target - len(live) if not ds.deleted else 0
@@ -515,8 +619,17 @@ class ServeController:
                 self._start_replica(app_name, ds)
                 changed = True
         elif excess > 0:
-            self._stop_replicas(ds, excess)
+            if ds.drain_capable:
+                self._drain_replicas(ds, excess)
+            else:
+                self._stop_replicas(ds, excess)
             changed = True
+        changed |= self._advance_drains(ds)
+        with self._lock:
+            draining = sum(1 for r in ds.replicas if r.state == "DRAINING")
+        self._m_draining.set(
+            draining, tags={"app": app_name, "deployment": name}
+        )
         # 6. status rollup
         with self._lock:
             running = sum(1 for r in ds.replicas if r.state == "RUNNING")
@@ -574,7 +687,9 @@ class ServeController:
             pass
 
     def _aggregate_inflight(self, app_name: str, dep_name: str) -> float:
-        now = time.monotonic()
+        """Sum router-reported in-flight load (one-clock rule: freshness
+        judged on obs.clock, the same clock get_routing_table stamps)."""
+        now = obs.clock()
         total = 0.0
         with self._lock:
             for rid, (ts, m) in list(self._router_metrics.items()):
@@ -583,6 +698,168 @@ class ServeController:
                     continue
                 total += m.get((app_name, dep_name), 0.0)
         return total
+
+    def _poll_snapshots(self, ds: _DeploymentState) -> None:
+        """Pull AutoscalingSnapshot from every RUNNING replica of a
+        signal-capable deployment, non-blocking (same ref discipline as
+        pings/probes: a slow replica must not stall the reconcile loop).
+        Snapshots are stamped with obs.clock at arrival (one-clock rule);
+        _aggregate_signals judges freshness on the same clock."""
+        if not ds.signal_capable:
+            return
+        now = obs.clock()
+        for r in list(ds.replicas):
+            if r.state != "RUNNING":
+                continue
+            if r.snapshot_ref is not None:
+                if self._ref_ready(r.snapshot_ref):
+                    try:
+                        snap = ray_tpu.get(r.snapshot_ref, timeout=5)
+                        with self._lock:
+                            ds.snapshots[r.actor_id.binary()] = (now, snap)
+                    except Exception:  # noqa: BLE001 — dead/failing replica;
+                        pass           # the health check owns its fate
+                    r.snapshot_ref = None
+                    r.next_snapshot_at = now + _SNAPSHOT_PERIOD_S
+                elif now > r.snapshot_deadline:
+                    r.snapshot_ref = None
+                    r.next_snapshot_at = now + _SNAPSHOT_PERIOD_S
+            elif now >= r.next_snapshot_at:
+                try:
+                    r.snapshot_ref = r.handle.rt_call.remote(
+                        "autoscaling_snapshot", (), {}
+                    )
+                    r.snapshot_deadline = now + _SNAPSHOT_TIMEOUT_S
+                except Exception:  # noqa: BLE001 — dead; step 1 reaps it
+                    pass
+
+    def _aggregate_signals(self, ds: _DeploymentState) -> list[dict]:
+        """Fresh snapshots, one per RUNNING replica (stale or orphaned
+        entries pruned). Freshness is judged on obs.clock against
+        AutoscalingConfig.signal_ttl_s — same clock the poll stamped."""
+        now = obs.clock()
+        cfg = ds.config.autoscaling_config
+        ttl = cfg.signal_ttl_s if cfg is not None else _METRIC_TTL_S
+        out = []
+        with self._lock:
+            running = {
+                r.actor_id.binary()
+                for r in ds.replicas
+                if r.state == "RUNNING"
+            }
+            for aid in list(ds.snapshots):
+                ts, snap = ds.snapshots[aid]
+                if aid not in running or now - ts > ttl:
+                    del ds.snapshots[aid]
+                    continue
+                out.append(snap)
+        return out
+
+    def _drain_replicas(self, ds: _DeploymentState, n: int) -> None:
+        """Graceful scale-down: STARTING victims (serving nothing) die
+        immediately; RUNNING victims — least-loaded first, by their last
+        snapshot's active_streams — flip to DRAINING, which removes them
+        from the routing table (only RUNNING replicas are routed) while
+        their in-flight streams keep decoding. _advance_drains retires
+        them once idle (after release_all) or at the drain deadline."""
+        to_kill: list[_ReplicaState] = []
+        to_drain: list[_ReplicaState] = []
+        with self._lock:
+            starting = [r for r in ds.replicas if r.state == "STARTING"]
+            to_kill = starting[:n]
+            want = n - len(to_kill)
+            if want > 0:
+                def load(r):
+                    entry = ds.snapshots.get(r.actor_id.binary())
+                    return entry[1].get("active_streams", 0) if entry else 0
+
+                running = sorted(
+                    (r for r in ds.replicas if r.state == "RUNNING"), key=load
+                )
+                to_drain = running[:want]
+            for r in to_kill:
+                ds.replicas.remove(r)
+            deadline = (
+                time.monotonic() + ds.config.graceful_shutdown_timeout_s
+            )
+            for r in to_drain:
+                r.state = "DRAINING"
+                r.drain_deadline = deadline
+                r.drain_ref = None
+                r.finish_ref = None
+        for r in to_kill:
+            try:
+                ray_tpu.kill(r.handle)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+        for r in to_drain:
+            try:
+                # prepare_drain stops admissions replica-side and returns a
+                # drain_status dict, so it doubles as the first poll
+                r.drain_ref = r.handle.rt_call.remote("prepare_drain", (), {})
+            except Exception:  # noqa: BLE001 — dead; step 1 reaps it
+                pass
+
+    def _advance_drains(self, ds: _DeploymentState) -> bool:
+        """Drive DRAINING replicas to retirement. States per replica:
+        polling drain_status (finish or hand off in-flight streams) ->
+        finish_drain once idle (release_all returns every KV block) ->
+        kill + leave ds.replicas. A replica that dies mid-drain — or one
+        still serving at the deadline — is killed as-is: its streams
+        resume byte-identically on survivors via the failover path."""
+        changed = False
+        now = time.monotonic()
+        for r in [r for r in ds.replicas if r.state == "DRAINING"]:
+            if r.finish_ref is not None:
+                # releasing: wait for finish_drain's release_all to land
+                if self._ref_ready(r.finish_ref) or now > r.drain_deadline:
+                    self._retire_drained(ds, r)
+                    changed = True
+                continue
+            idle = False
+            dead = False
+            if r.drain_ref is not None:
+                if self._ref_ready(r.drain_ref):
+                    try:
+                        status = ray_tpu.get(r.drain_ref, timeout=5)
+                        idle = status.get("active_streams", 0) == 0
+                    except Exception:  # noqa: BLE001 — died mid-drain; the
+                        dead = True    # failover path owns its streams
+                    r.drain_ref = None
+            else:
+                try:
+                    r.drain_ref = r.handle.rt_call.remote(
+                        "drain_status", (), {}
+                    )
+                except Exception:  # noqa: BLE001
+                    dead = True
+            if dead:
+                self._retire_drained(ds, r)
+                changed = True
+            elif idle:
+                try:
+                    r.finish_ref = r.handle.rt_call.remote(
+                        "finish_drain", (), {}
+                    )
+                    # short grace for the block release to land
+                    r.drain_deadline = now + 5.0
+                except Exception:  # noqa: BLE001
+                    self._retire_drained(ds, r)
+                changed = True
+            elif now > r.drain_deadline:
+                self._retire_drained(ds, r)
+                changed = True
+        return changed
+
+    def _retire_drained(self, ds: _DeploymentState, r: _ReplicaState) -> None:
+        with self._lock:
+            if r in ds.replicas:
+                ds.replicas.remove(r)
+            ds.snapshots.pop(r.actor_id.binary(), None)
+        try:
+            ray_tpu.kill(r.handle)
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
 
     def _start_replica(self, app_name: str, ds: _DeploymentState) -> None:
         spec = ds.spec
@@ -613,7 +890,11 @@ class ServeController:
             num_tpus=num_tpus,
             resources=resources or None,
             max_restarts=0,  # the reconciler owns restarts, not the raylet
-            max_concurrency=max_concurrency,
+            # headroom beyond the data-plane bound so control calls (ping /
+            # autoscaling_snapshot / drain_status) don't park behind
+            # max_ongoing_requests concurrent streams; routers still cap
+            # data dispatches at max_ongoing_requests
+            max_concurrency=max_concurrency + _CONTROL_SLOTS,
         )
         handle = actor_cls.remote(
             spec["name"],

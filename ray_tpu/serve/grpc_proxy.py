@@ -69,8 +69,15 @@ def _code_for(e: BaseException):
     DEADLINE_EXCEEDED, cancelled -> CANCELLED, else INTERNAL."""
     import grpc
 
+    from ray_tpu.util import metrics
+
     e = _unwrap(e)
     if isinstance(e, EngineOverloadedError):
+        metrics.counter(
+            "serve_requests_shed",
+            "Requests rejected with an overload status at a proxy",
+            tag_keys=("proxy",),
+        ).inc(tags={"proxy": "grpc"})
         return grpc.StatusCode.RESOURCE_EXHAUSTED
     if isinstance(e, DeadlineExceededError):
         return grpc.StatusCode.DEADLINE_EXCEEDED

@@ -20,9 +20,14 @@ from typing import Any
 
 import ray_tpu
 from ray_tpu._private import chaos
-from ray_tpu.util import tracing
+from ray_tpu.exceptions import EngineOverloadedError
+from ray_tpu.util import metrics, tracing
 
 _TABLE_REFRESH_S = 0.25
+# how long a mid-stream failover RESUME keeps retrying through transient
+# EngineOverloadedError (draining-replica race, momentary saturation)
+# before failing the half-delivered stream
+_RESUME_OVERLOAD_RETRY_S = 10.0
 
 
 class DeploymentResponse:
@@ -146,6 +151,7 @@ class ResumableStreamGenerator:
         self.chunks: list = []   # every chunk delivered to the caller
         self.failovers = 0
         self._exclude: set[bytes] = set()
+        self._overload_deadline: float | None = None
 
     def __iter__(self):
         return self
@@ -169,11 +175,31 @@ class ResumableStreamGenerator:
             except StopIteration:
                 raise
             except BaseException as e:  # noqa: BLE001 — classify below
+                cause = _failover_cause(e)
+                if (isinstance(cause, EngineOverloadedError)
+                        and self.failovers > 0):
+                    # a resume re-dispatch raced a draining/overloaded
+                    # replica. The FIRST dispatch propagates overload (the
+                    # caller gets 503 + Retry-After), but once chunks have
+                    # been delivered the lossless-failover contract says
+                    # this stream must finish — retry briefly instead of
+                    # failing a half-delivered stream.
+                    now = time.monotonic()
+                    if self._overload_deadline is None:
+                        self._overload_deadline = (
+                            now + _RESUME_OVERLOAD_RETRY_S)
+                    if now > self._overload_deadline:
+                        raise
+                    self._inner = None
+                    self._payload = self._resume(list(self.chunks))
+                    time.sleep(0.1)
+                    continue
                 if (
-                    not isinstance(_failover_cause(e), retryable)
+                    not isinstance(cause, retryable)
                     or self.failovers >= self._max_failovers
                 ):
                     raise
+                self._overload_deadline = None
                 self.failovers += 1
                 aid = getattr(self._inner, "replica_actor_id", None)
                 if aid is not None:
@@ -231,6 +257,16 @@ class _Router:
         self._outstanding: dict[bytes, bytes] = {}  # object_id -> actor_id
         self._last_refresh = 0.0
         self._controller = None
+        # cluster-wide admission: the controller marks the deployment shed
+        # when the whole fleet is saturated (fleet_saturated); data-plane
+        # dispatches then fail fast with EngineOverloadedError instead of
+        # queuing doomed work (proxies map it to 503 + Retry-After)
+        self._shed = False
+        self._m_shed = metrics.counter(
+            "llm_requests_shed",
+            "Requests shed at admission while the fleet is saturated",
+            tag_keys=("app", "deployment"),
+        )
 
     # -- table management --
 
@@ -247,13 +283,13 @@ class _Router:
             if not force and now - self._last_refresh < _TABLE_REFRESH_S:
                 return
             self._last_refresh = now
-            metrics = {
+            load_report = {
                 (self.app_name, self.deployment_name): sum(self._inflight.values())
             }
         self._sweep()
         table = ray_tpu.get(
             self._controller_handle().get_routing_table.remote(
-                self.router_id, {tuple(k): v for k, v in metrics.items()}
+                self.router_id, {tuple(k): v for k, v in load_report.items()}
             ),
             timeout=30,
         )
@@ -271,6 +307,7 @@ class _Router:
             self._batch_configs = dep["batch_configs"]
             self._stream_methods = set(dep.get("stream_methods", ()))
             self._max_ongoing = dep["max_ongoing_requests"]
+            self._shed = bool(dep.get("shed", False))
 
     # -- in-flight accounting --
 
@@ -343,6 +380,19 @@ class _Router:
             )
         with self._lock:
             is_stream = method_name in self._stream_methods
+            shed = self._shed
+        if shed and not exclude and (is_stream or method_name == "__call__"):
+            # fleet-wide saturation: reject NEW data-plane work before it
+            # queues (control methods — cancel, stats, debug — still pass;
+            # failover resumes carry ``exclude`` and are never shed so a
+            # half-delivered stream always finishes)
+            self._m_shed.inc(tags={"app": self.app_name,
+                                   "deployment": self.deployment_name})
+            raise EngineOverloadedError(
+                f"{self.app_name}/{self.deployment_name}: all replicas "
+                "saturated (queue backlog + KV pressure on every replica); "
+                "shedding at admission — retry later"
+            )
         replica = self._pick_replica(time.monotonic() + 30, exclude)
         aid = replica._actor_id.binary()
         # when the caller carries a trace, open a dispatch span so the

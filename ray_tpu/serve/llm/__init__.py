@@ -14,44 +14,50 @@ closed bucket set so the XLA compile cache stays bounded (arxiv
 - api.py      — LLMDeployment: the engine as a streaming Serve deployment
 
 See docs/SERVING_LLM.md for the design.
-"""
-from ray_tpu.exceptions import (
-    DeadlineExceededError,
-    EngineDiedError,
-    EngineOverloadedError,
-    RequestCancelledError,
-)
-from ray_tpu.serve.config import ModelParallelConfig
-from ray_tpu.serve.llm.api import LLMDeployment, build_llm_app, stream_tokens
-from ray_tpu.serve.llm.drafter import Drafter, NGramDrafter, build_drafter
-from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, SamplingParams
-from ray_tpu.serve.llm.executor import (
-    ModelExecutor,
-    ShardedExecutor,
-    SingleDeviceExecutor,
-    build_executor,
-)
-from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
 
-__all__ = [
-    "DeadlineExceededError",
-    "Drafter",
-    "EngineConfig",
-    "EngineDiedError",
-    "EngineOverloadedError",
-    "KVCacheConfig",
-    "LLMDeployment",
-    "LLMEngine",
-    "ModelExecutor",
-    "ModelParallelConfig",
-    "NGramDrafter",
-    "PagedKVCache",
-    "RequestCancelledError",
-    "SamplingParams",
-    "ShardedExecutor",
-    "SingleDeviceExecutor",
-    "build_drafter",
-    "build_executor",
-    "stream_tokens",
-    "build_llm_app",
-]
+Exports resolve lazily (PEP 562): the engine/decode modules pull in jax,
+and light consumers — notably the serve controller, which imports
+``serve.llm.obs`` for the one-clock rule when aggregating autoscaling
+snapshots — must not pay that import in their process.
+"""
+from __future__ import annotations
+
+import importlib
+
+_EXPORTS = {
+    "DeadlineExceededError": "ray_tpu.exceptions",
+    "EngineDiedError": "ray_tpu.exceptions",
+    "EngineOverloadedError": "ray_tpu.exceptions",
+    "RequestCancelledError": "ray_tpu.exceptions",
+    "ModelParallelConfig": "ray_tpu.serve.config",
+    "LLMDeployment": "ray_tpu.serve.llm.api",
+    "build_llm_app": "ray_tpu.serve.llm.api",
+    "stream_tokens": "ray_tpu.serve.llm.api",
+    "Drafter": "ray_tpu.serve.llm.drafter",
+    "NGramDrafter": "ray_tpu.serve.llm.drafter",
+    "build_drafter": "ray_tpu.serve.llm.drafter",
+    "EngineConfig": "ray_tpu.serve.llm.engine",
+    "LLMEngine": "ray_tpu.serve.llm.engine",
+    "SamplingParams": "ray_tpu.serve.llm.engine",
+    "ModelExecutor": "ray_tpu.serve.llm.executor",
+    "ShardedExecutor": "ray_tpu.serve.llm.executor",
+    "SingleDeviceExecutor": "ray_tpu.serve.llm.executor",
+    "build_executor": "ray_tpu.serve.llm.executor",
+    "KVCacheConfig": "ray_tpu.serve.llm.kv_cache",
+    "PagedKVCache": "ray_tpu.serve.llm.kv_cache",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(mod), name)
+    globals()[name] = value  # cache for subsequent lookups
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

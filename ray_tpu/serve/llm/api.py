@@ -36,6 +36,7 @@ from collections import OrderedDict
 from typing import Any
 
 from ray_tpu._private import chaos
+from ray_tpu.exceptions import EngineOverloadedError
 from ray_tpu.serve.deployment import Application, deployment
 from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, SamplingParams
 from ray_tpu.util import metrics, tracing
@@ -94,6 +95,9 @@ class LLMDeployment:
             "llm_requests_resumed",
             "Streams resumed on this replica after another replica died",
         )
+        # graceful-drain latch (controller-driven scale-down): a draining
+        # replica admits nothing new; in-flight streams finish or hand off
+        self._draining = False
 
     def __call__(self, payload: dict | None):
         """Generator: one chunk per generated token.
@@ -105,6 +109,15 @@ class LLMDeployment:
         where ``index`` is absolute — a resumed stream continues the
         numbering of the stream it replaces.
         """
+        if self._draining:
+            # Scale-down marked this replica draining; the routing table
+            # already excludes it, so only a dispatch racing the table
+            # refresh lands here. EngineOverloadedError is the retryable
+            # "go elsewhere" signal: failover resumes re-dispatch to a
+            # survivor, fresh requests get 503 + Retry-After.
+            raise EngineOverloadedError(
+                "replica is draining for scale-down; retry another replica"
+            )
         payload = payload or {}
         prompt = payload.get("prompt", "")
         if isinstance(prompt, str):
@@ -198,7 +211,55 @@ class LLMDeployment:
         the proxy's /debug/llm endpoint)."""
         out = self.engine.debug_dump()
         out["requests_resumed"] = self._resumed_total
+        out["draining"] = self._draining
         return out
+
+    # ---------------- autoscaling & graceful drain ----------------
+
+    def autoscaling_snapshot(self) -> dict:
+        """Engine saturation signals for the controller's autoscaler
+        (docs/SERVING_LLM.md "Autoscaling & graceful drain"). The
+        ``llm.snapshot`` chaos point sits here so the load harness can
+        delay/jitter snapshot reporting deterministically."""
+        chaos.fire("llm.snapshot")
+        out = self.engine.autoscaling_snapshot()
+        out["draining"] = self._draining
+        out["active_streams"] = len(self._active)
+        return out
+
+    def prepare_drain(self) -> dict:
+        """Controller scale-down hook: stop admitting, keep serving.
+
+        After this returns, new ``__call__`` dispatches are refused with
+        ``EngineOverloadedError`` while every in-flight stream keeps
+        decoding; the controller polls ``drain_status`` and finishes (or
+        kills — the failover path hands the streams to survivors
+        byte-identically) once the replica is idle or the drain deadline
+        expires. Idempotent."""
+        self._draining = True
+        chaos.fire("replica_drain", active=len(self._active))
+        return self.drain_status()
+
+    def drain_status(self) -> dict:
+        return {
+            "draining": self._draining,
+            "active_streams": len(self._active),
+        }
+
+    def finish_drain(self) -> dict:
+        """Terminal drain step, called by the controller once no streams
+        are active: returns every KV block (allocations, reservations,
+        quarantine, prefix cache) to the pool via the engine's
+        ``release_all`` shutdown path and reports the final accounting so
+        the caller can assert the pool is leak-free before the actor is
+        killed."""
+        self.engine.shutdown()
+        snap = self.engine.cache.debug_snapshot()
+        return {
+            "released": True,
+            "leaked_blocks": snap["used_blocks"],
+            "cache": snap,
+        }
 
 
 def stream_tokens(handle, payload: dict, *, max_failovers: int = 2):

@@ -110,6 +110,25 @@ logger = logging.getLogger("ray_tpu.serve.llm")
 
 _DONE = object()  # stream sentinel
 
+# Window (obs.clock seconds) over which autoscaling_snapshot() turns
+# deadline-miss / rejection event timestamps into rates.
+_SIGNAL_RATE_WINDOW_S = 30.0
+
+
+def _pctile(samples, q: float) -> float:
+    """Nearest-rank percentile of a small sample window; 0.0 when empty."""
+    if not samples:
+        return 0.0
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def _window_rate(clocks: deque, now: float) -> float:
+    """Events/second over the trailing window; prunes expired entries."""
+    while clocks and now - clocks[0] > _SIGNAL_RATE_WINDOW_S:
+        clocks.popleft()
+    return len(clocks) / _SIGNAL_RATE_WINDOW_S
+
 
 @dataclass(frozen=True)
 class SamplingParams:
@@ -444,6 +463,15 @@ class LLMEngine:
         # cache-stat values as of the previous flight record (deltas)
         self._flight_prev = {"cow": 0, "evict": 0}
         self._dumped = False  # one post-mortem dump per engine
+        # ---- autoscaling signal windows (ISSUE 10) ----
+        # Bounded sample/event rings feeding autoscaling_snapshot(): the
+        # controller's policy wants recent-tail saturation (queue-wait
+        # p95, decode-step p50, miss/reject rates), not lifetime totals.
+        self._queue_wait_window: deque[float] = deque(maxlen=256)
+        self._decode_step_window: deque[float] = deque(maxlen=256)
+        self._reject_clocks: deque[float] = deque(maxlen=512)
+        self._deadline_clocks: deque[float] = deque(maxlen=512)
+        self._last_snapshot: dict | None = None
 
         self._m_tokens = metrics.counter(
             "llm_engine_tokens_generated",
@@ -514,6 +542,20 @@ class LLMEngine:
             "Devices driven by this engine's model executor",
         )
         self._m_devices.set(self.executor.num_devices)
+        # autoscaling-signal gauges, refreshed on every snapshot pull
+        self._m_as_queue = metrics.gauge(
+            "llm_queue_depth",
+            "Admission queue depth as seen by the autoscaler",
+        )
+        self._m_as_kv_free = metrics.gauge(
+            "llm_kv_free_blocks",
+            "Truly free (unallocated, uncached) KV blocks in the pool",
+        )
+        self._m_as_kv_pressure = metrics.gauge(
+            "llm_kv_pool_pressure",
+            "Fraction of the usable KV pool a new admission cannot claim "
+            "(allocations + reservations + quarantine)",
+        )
         # count compile events by shape key as DecodeFns sees new
         # signatures (attribute hook, forwarded through the executor —
         # DecodeFns stays constructible bare)
@@ -577,6 +619,7 @@ class LLMEngine:
             ):
                 self._rejected_total += 1
                 self._m_rejected.inc()
+                self._reject_clocks.append(obs.clock())
                 raise EngineOverloadedError(
                     f"admission queue full ({len(self._waiting)} waiting, "
                     f"{self._waiting_blocks} worst-case blocks queued); "
@@ -740,16 +783,77 @@ class LLMEngine:
                 return self._timeline_dict(r)
             return self._timelines.get(request_id)
 
+    def autoscaling_snapshot(self) -> dict:
+        """Saturation signals for the controller's autoscaling policy
+        (serve/autoscaling_policy.py desired_from_signals): queue depth +
+        queue-wait p95, KV-pool block accounting collapsed into a single
+        pressure fraction, deadline-miss / rejection rates over a trailing
+        window, and decode-step p50. All host-side integers/floats — O(1)
+        plus a sort of two bounded sample windows — so the controller can
+        pull it every reconcile period. Also refreshes the
+        ``llm_queue_depth`` / ``llm_kv_free_blocks`` /
+        ``llm_kv_pool_pressure`` gauges and records the snapshot in the
+        flight ring (``kind="autoscale_snapshot"``)."""
+        with self._lock:
+            return self._autoscaling_snapshot_locked()
+
+    def _autoscaling_snapshot_locked(self, record: bool = True) -> dict:
+        now = obs.clock()
+        cache = self.cache
+        usable = max(1, cache.cfg.usable_blocks)
+        snap = cache.debug_snapshot()
+        # Pressure = the fraction of the usable pool a NEW admission
+        # cannot claim: live allocations, reservations, and quarantined
+        # blocks all count against it; LRU-cached prefix blocks do not
+        # (they are evictable on demand).
+        claimable = max(0, cache.available_blocks - snap["reserved_blocks"])
+        pressure = min(1.0, max(0.0, 1.0 - claimable / usable))
+        out = {
+            "ts_wall": obs.wall(),
+            "clock": now,
+            "queue_depth": len(self._waiting),
+            "queue_wait_p95_s": round(
+                _pctile(self._queue_wait_window, 0.95), 6
+            ),
+            "decode_step_p50_s": round(
+                _pctile(self._decode_step_window, 0.50), 6
+            ),
+            "kv_free_blocks": snap["free_blocks"],
+            "kv_cached_blocks": snap["cached_blocks"],
+            "kv_quarantined_blocks": snap["quarantined_blocks"],
+            "kv_pool_pressure": round(pressure, 4),
+            "deadline_miss_rate": round(
+                _window_rate(self._deadline_clocks, now), 4
+            ),
+            "rejection_rate": round(
+                _window_rate(self._reject_clocks, now), 4
+            ),
+            "running": len(self._running),
+            "prefilling": len(self._prefilling),
+            "failed": self._failed is not None,
+        }
+        self._m_as_queue.set(out["queue_depth"])
+        self._m_as_kv_free.set(out["kv_free_blocks"])
+        self._m_as_kv_pressure.set(out["kv_pool_pressure"])
+        self._last_snapshot = out
+        if record:  # debug_dump() observes without touching the ring
+            self._flight.record(dict(out, kind="autoscale_snapshot",
+                                     ts=out["ts_wall"]))
+        return out
+
     def debug_dump(self) -> dict:
         """One-call post-mortem/state dump: flight-recorder ring, engine
-        stats, cache snapshot, compiled shapes, and the process's
-        event_stats. Exposed replica-side as ``LLMDeployment.debug_dump``
-        and proxy-side as ``GET /debug/llm``."""
+        stats, cache snapshot, the latest autoscaling snapshot, compiled
+        shapes, and the process's event_stats. Exposed replica-side as
+        ``LLMDeployment.debug_dump`` and proxy-side as
+        ``GET /debug/llm``."""
         with self._lock:
             return self._flight.dump("debug", extra={
                 "stats": self.stats(),
                 "executor": self.executor.describe(),
                 "cache": self.cache.debug_snapshot(),
+                "autoscaling_snapshot": self._autoscaling_snapshot_locked(
+                    record=False),
                 "compiled_shapes": sorted(
                     obs.shape_key(s) for s in self.fns.signatures
                 ),
@@ -860,6 +964,7 @@ class LLMEngine:
             self._evict_locked(r)
             self._deadline_total += 1
             self._m_deadline.inc()
+            self._deadline_clocks.append(obs.clock())
             expired += 1
             self._finish_obs_locked(r, "expired")
             r.out.put(
@@ -953,9 +1058,9 @@ class LLMEngine:
                 )
                 self._prefilling.append(req)
                 admitted += 1
-                self._m_queue_wait.observe(
-                    obs.clock() - req.submitted_clock
-                )
+                wait = obs.clock() - req.submitted_clock
+                self._m_queue_wait.observe(wait)
+                self._queue_wait_window.append(wait)
                 self._tl(req, "admitted",
                          cached_tokens=req.cached_tokens,
                          reserved_blocks=req.reserved_blocks)
@@ -1219,6 +1324,7 @@ class LLMEngine:
         self._m_util.set(self.cache.utilization)
         self._sync_cache_counters_locked()
         self._m_latency.observe(dt, tags={"kind": "decode"})
+        self._decode_step_window.append(dt)
         event_stats.record("llm.engine.step.decode", dt)
         self._flight_record_locked(
             "decode", t0_wall, dt, batch=len(batch), bucket_b=B,
@@ -1374,6 +1480,7 @@ class LLMEngine:
         self._m_util.set(self.cache.utilization)
         self._sync_cache_counters_locked()
         self._m_latency.observe(dt, tags={"kind": "verify"})
+        self._decode_step_window.append(dt)
         event_stats.record("llm.engine.step.verify", dt)
         self._flight_record_locked(
             "verify", t0_wall, dt, batch=len(batch), bucket_b=B,
@@ -1653,6 +1760,9 @@ class LLMEngine:
         if not lock_free:
             extra["stats"] = self.stats()
             extra["cache"] = self.cache.debug_snapshot()
+        if self._last_snapshot is not None:
+            # plain-attribute read: safe on the lock-free watchdog path
+            extra["autoscaling_snapshot"] = self._last_snapshot
         out = obs.write_dump(
             self._flight.dump(reason, extra=extra),
             dir=self.cfg.flight_recorder_dir, path=path,

@@ -75,8 +75,15 @@ def _status_for(e: BaseException) -> tuple[int, dict]:
     """Map framework errors to HTTP degradation statuses: overload is
     retryable (503 + Retry-After), a blown deadline is a gateway timeout
     (504), a cancelled request is nginx's client-closed-request (499)."""
+    from ray_tpu.util import metrics
+
     e = _unwrap(e)
     if isinstance(e, EngineOverloadedError):
+        metrics.counter(
+            "serve_requests_shed",
+            "Requests rejected with an overload status at a proxy",
+            tag_keys=("proxy",),
+        ).inc(tags={"proxy": "http"})
         return 503, {"Retry-After": "1"}
     if isinstance(e, DeadlineExceededError):
         return 504, {}

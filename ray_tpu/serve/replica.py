@@ -197,6 +197,17 @@ class ReplicaActor:
         return {
             "batch_configs": self.batch_configs(),
             "stream_methods": self.stream_methods(),
+            # engine-signal autoscaling + graceful drain are opt-in by
+            # capability: the controller only polls/drains deployments
+            # whose instances expose the hooks (serve.llm LLMDeployment)
+            "has_autoscaling_snapshot": (
+                not self._is_function
+                and callable(getattr(self._instance, "autoscaling_snapshot", None))
+            ),
+            "has_drain": (
+                not self._is_function
+                and callable(getattr(self._instance, "prepare_drain", None))
+            ),
         }
 
     # -- data surface --

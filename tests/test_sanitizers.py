@@ -236,6 +236,68 @@ def test_one_clock_in_llm_serving_path():
     )
 
 
+def test_one_clock_in_autoscaling_control_plane():
+    """Autoscaling lint (ISSUE 10): scale decisions and snapshot freshness
+    must be judged on the SAME clock the engine stamps its snapshots with
+    (obs.clock / obs.wall). A bare ``time.time()``/``time.monotonic()``/
+    ``time.perf_counter()`` in the policy module or in the controller's
+    aggregation path silently compares engine clock stamps against a
+    different timebase, so snapshot TTLs (and therefore up/down decisions)
+    drift. Scope: all of serve/autoscaling_policy.py, plus the
+    controller's snapshot-aggregation functions — lifecycle deadline math
+    elsewhere in the controller legitimately uses time.monotonic."""
+    import ast
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    banned = {"time", "monotonic", "perf_counter"}
+    aggregation_fns = frozenset(
+        {"_aggregate_inflight", "_aggregate_signals", "_poll_snapshots"})
+
+    def raw_clock_calls(path, within=None):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        chains: dict[ast.AST, frozenset] = {}
+
+        def tag(node, chain):
+            for child in ast.iter_child_nodes(node):
+                c = chain
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    c = chain | {child.name}
+                chains[child] = c
+                tag(child, c)
+
+        tag(tree, frozenset())
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if within is not None and not (
+                chains.get(node, frozenset()) & within
+            ):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in banned
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"
+            ):
+                out.append(f"{path.relative_to(root)}:{node.lineno}")
+        return out
+
+    policy = root / "ray_tpu" / "serve" / "autoscaling_policy.py"
+    controller = root / "ray_tpu" / "serve" / "controller.py"
+    # the scoped functions must exist — a rename would silently un-lint them
+    ctrl_src = controller.read_text()
+    for fn in aggregation_fns:
+        assert f"def {fn}(" in ctrl_src, f"controller lost {fn}()"
+    offenders = raw_clock_calls(policy)
+    offenders += raw_clock_calls(controller, within=aggregation_fns)
+    assert not offenders, (
+        f"raw clock reads in the autoscaling control plane: {offenders}"
+    )
+
+
 def test_decode_attention_path_never_materializes_kv():
     """Decode-perf lint (ISSUE 8): the single-token decode attention call
     graph must stay fused. ``gather_kv`` materializes [B, NB*bs, Hkv, hd]

@@ -1,0 +1,42 @@
+"""Full chaos load harness (slow tier): seeded skewed open-loop traffic
+through a replica kill, a graceful drain, and signal-driven autoscaling.
+
+The tier-1 deterministic storyline lives in test_serve_autoscale.py; this
+runs benchmarks.llm_serving.run_load_bench once end-to-end and asserts
+its robustness contract: every accepted stream byte-identical to an
+unfaulted reference (zero dropped or duplicated tokens), shed requests
+accounted separately, and the three load metrics emitted.
+"""
+from __future__ import annotations
+
+import pytest
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(900)
+def test_chaos_load_bench_lossless(jax_cpu):
+    from ray_tpu.benchmarks.llm_serving import run_load_bench
+
+    out = run_load_bench()
+
+    # the three required load metrics are present (latencies non-null:
+    # at least one stream must have been accepted and produced tokens)
+    assert out["llm_load_ttft_p99_ms"] is not None
+    assert out["llm_load_tpot_p99_ms"] is not None
+    assert 0.0 <= out["llm_load_shed_rate"] < 1.0
+
+    # robustness contract: no stream errors, every accepted stream
+    # byte-identical to the unfaulted local reference
+    assert out["llm_load_errors"] == 0, out
+    assert out["llm_load_lossless"] is True, out
+    assert out["llm_load_completed"] >= 1
+
+    # the chaos kill forced at least one lossless mid-stream failover
+    assert out["llm_load_failovers"] >= 1, out
+
+    # the storyline exercised the control plane: at least one autoscale
+    # target change (signal upscale and/or the explicit drain) and a
+    # replica observed DRAINING
+    assert out["llm_load_scale_events"] >= 1, out
+    assert out["llm_load_drain_observed"] is True, out
