@@ -23,6 +23,21 @@ Hook sites currently instrumented:
                         (context: active — in-flight stream count)
   ``controller_scale``— before the controller applies a replica-count
                         change (context: app, deployment, current, target)
+  ``llm.handoff.seal`` — on a prefill replica after prefill, before the
+                        KV blocks are exported/sealed into the object
+                        store (context: request_id, attempt, tag —
+                        ``kill`` here is the canonical
+                        prefill-dies-mid-handoff chaos test)
+  ``llm.handoff.fetch``— on a decode replica before it fetches a handoff
+                        payload from the object store
+                        (context: attempt, tag)
+  ``llm.handoff.land`` — on a decode replica after the fetch, before
+                        verify+adopt lands the blocks in its pool
+                        (context: attempt, tag)
+  ``object_store.get`` — top of ObjectStoreClient.get, before the local
+                        mmap cache is consulted (context: object_id hex,
+                        timeout_ms — ``raise``/``delay`` here make store
+                        fetch faults injectable like every other RPC)
 
 Plans install either in-process (``install``, for unit tests driving an
 engine directly) or via the ``RAY_TPU_CHAOS_PLAN`` environment variable

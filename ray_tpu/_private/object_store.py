@@ -243,7 +243,21 @@ class ObjectStoreClient:
             self._cache_mapping(key, m, replace=True)
 
     def get(self, object_id: ObjectID, timeout_ms: int = 0) -> memoryview | None:
-        """Zero-copy read view, or None if absent (timeout_ms=0 → no wait)."""
+        """Zero-copy read view, or None if absent (timeout_ms=0 → no wait).
+
+        Deleted/evicted objects surface PROMPTLY as EVICTED: the daemon
+        tombstones on every delete and wakes blocked getters (store.cpp),
+        so a get racing a delete returns in one round-trip, not after the
+        full timeout. The ``object_store.get`` chaos point fires before
+        the local cache is consulted, making store fetch faults (used by
+        the KV-handoff chaos tests) injectable like every other RPC."""
+        from ray_tpu._private import chaos
+
+        chaos.fire(
+            "object_store.get",
+            object_id=object_id.hex(),
+            timeout_ms=int(timeout_ms),
+        )
         key = object_id.binary()
         # Cache hit: the data is immutable and our mmap stays valid even if
         # the server evicts the segment (the kernel keeps mapped pages), so

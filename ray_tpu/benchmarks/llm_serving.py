@@ -78,7 +78,13 @@ Reported (one JSON line, merged into bench.py's aux results under
                               accepted stream matched an unfaulted
                               local reference byte-for-byte (zero
                               dropped or duplicated tokens through
-                              kill + drain)
+                              kill + drain); the bimodal prompt mix
+                              also reports
+                              ``llm_load_decode_tpot_p99_ms_short`` /
+                              ``_long`` — decode TPOT per prompt class,
+                              the number disaggregated prefill
+                              (``run_load_bench(prefill_replicas=1)``)
+                              is judged on
 
 Runs on CPU with the tiny llama config — the point is tracking the
 scheduler/cache overheads and the hit-rate plumbing release-over-release,
@@ -124,6 +130,13 @@ LOAD_BURST_GAP_S = 6.0
 LOAD_DRAIN_AT_S = 11.0   # scale_deployment -> 1 (graceful drain) offset
 LOAD_NEW_TOKENS = 12
 LOAD_KILL_INDEX = 2      # chunk index after which the tagged replica dies
+# Bimodal prompt mix (the disaggregation workload): mostly short chat
+# turns plus a long-document minority whose monolithic prefills are
+# exactly what stalls co-located decoders. Decode TPOT is reported per
+# class so the long-prefill interference on SHORT streams is visible.
+LOAD_LONG_FRACTION = 0.3
+LOAD_SHORT_PROMPT = (3, 9)    # uniform token-count range, inclusive-lo
+LOAD_LONG_PROMPT = (48, 81)
 
 
 def _ensure_virtual_devices(n: int) -> None:
@@ -519,22 +532,27 @@ def run_spec_decode_bench() -> dict:
 
 def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
     """Seeded open-loop request schedule: (index, start offset s, payload)
-    per request. Skewed prompt lengths (zipf) and bursty arrivals; the
-    first request of the SECOND burst carries the chaos kill tag so the
-    kill lands while both the heavy first burst's stragglers and fresh
-    work are in flight."""
+    per request. Bimodal prompt lengths (LOAD_LONG_FRACTION long-document
+    prompts amid short chat turns) and bursty arrivals; the first request
+    of the SECOND burst carries the chaos kill tag so the kill lands
+    while both the heavy first burst's stragglers and fresh work are in
+    flight. Each payload is marked with its ``prompt_class`` so the
+    harness can split decode-TPOT percentiles by class."""
     requests = []
     base = 0.0
     idx = 0
     for size in LOAD_BURSTS:
         for _ in range(size):
-            n = int(min(3 + rng.zipf(1.8), 24))
+            is_long = bool(rng.random() < LOAD_LONG_FRACTION)
+            lo, hi = LOAD_LONG_PROMPT if is_long else LOAD_SHORT_PROMPT
+            n = int(rng.integers(lo, hi))
             payload = {
                 "prompt": [int(x) for x in rng.integers(1, vocab_size, n)],
                 "request_id": f"load-{idx}",
                 "max_new_tokens": LOAD_NEW_TOKENS,
                 "temperature": 0.8,
                 "seed": 1000 + idx,
+                "prompt_class": "long" if is_long else "short",
             }
             requests.append((idx, base + float(rng.random() * 0.5), payload))
             idx += 1
@@ -543,9 +561,16 @@ def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
     return requests
 
 
-def run_load_bench() -> dict:
+def run_load_bench(prefill_replicas: int = 0) -> dict:
     """Multi-replica chaos load harness: open-loop seeded bursty traffic
     through a kill + graceful drain + signal-driven autoscale event.
+
+    ``prefill_replicas > 0`` runs the same storyline against a
+    DISAGGREGATED app (a separate prefill pool hands KV blocks to the
+    decode pool over the object plane): the bimodal schedule's long
+    prompts then prefill off the decode replicas, and comparing
+    ``llm_load_decode_tpot_p99_ms_short`` against the co-located run
+    shows the interference the split removes.
 
     Storyline (all inside one ~20 s traffic window):
       1. the app starts at min_replicas=1; the heavy first burst trips
@@ -607,7 +632,8 @@ def run_load_bench() -> dict:
                "chunks": [], "arrivals": [],
                "dispatched": time.perf_counter(), "failovers": 0}
         while True:
-            gen = stream_tokens(handle, payload)
+            gen = stream_tokens(
+                handle, payload, prefill_handle=prefill_handle)
             try:
                 for chunk in gen:
                     rec["arrivals"].append(time.perf_counter())
@@ -637,22 +663,28 @@ def run_load_bench() -> dict:
             results.append(rec)
 
     ray_tpu.init(num_cpus=8)
+    dep_name = "LLMDecode" if prefill_replicas > 0 else "LLMDeployment"
     try:
+        autoscaling = dict(
+            min_replicas=1, max_replicas=2,
+            # CPU tiny-model queue waits are short; lower the trip
+            # point so the first burst reliably reads as HOT
+            upscale_queue_wait_p95_s=0.05,
+            upscale_delay_periods=1,
+            # never scale down on policy mid-bench — the one
+            # scale-down is the harness's explicit drain event
+            downscale_delay_periods=10_000,
+        )
+        app_kwargs: dict = {"autoscaling_config": autoscaling}
+        if prefill_replicas > 0:
+            app_kwargs["prefill_replicas"] = prefill_replicas
         handle = serve.run(
-            build_llm_app(
-                ecfg,
-                autoscaling_config=dict(
-                    min_replicas=1, max_replicas=2,
-                    # CPU tiny-model queue waits are short; lower the trip
-                    # point so the first burst reliably reads as HOT
-                    upscale_queue_wait_p95_s=0.05,
-                    upscale_delay_periods=1,
-                    # never scale down on policy mid-bench — the one
-                    # scale-down is the harness's explicit drain event
-                    downscale_delay_periods=10_000,
-                ),
-            ),
+            build_llm_app(ecfg, **app_kwargs),
             name="llm-load", timeout_s=300,
+        )
+        prefill_handle = (
+            serve.get_deployment_handle("LLMPrefill", "llm-load")
+            if prefill_replicas > 0 else None
         )
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
 
@@ -660,7 +692,7 @@ def run_load_bench() -> dict:
             while not stop.is_set():
                 try:
                     st = ray_tpu.get(ctrl.status.remote(), timeout=10)
-                    d = st.get("llm-load", {}).get("LLMDeployment")
+                    d = st.get("llm-load", {}).get(dep_name)
                     if d:
                         status_samples.append(d)
                 except Exception:  # noqa: BLE001 — controller busy; skip
@@ -680,7 +712,7 @@ def run_load_bench() -> dict:
 
         def _dep():
             st = ray_tpu.get(ctrl.status.remote(), timeout=10)
-            return st.get("llm-load", {}).get("LLMDeployment") or {}
+            return st.get("llm-load", {}).get(dep_name) or {}
 
         def drainer():
             delay = LOAD_DRAIN_AT_S - (time.perf_counter() - t0)
@@ -698,7 +730,7 @@ def run_load_bench() -> dict:
                     pass
                 time.sleep(0.2)
             ray_tpu.get(ctrl.scale_deployment.remote(
-                "llm-load", "LLMDeployment", 1), timeout=30)
+                "llm-load", dep_name, 1), timeout=30)
             # an idle drain resolves faster than the sampler's 0.2 s
             # cadence — sample tightly until DRAINING (or done) is seen
             for _ in range(200):
@@ -751,8 +783,17 @@ def run_load_bench() -> dict:
     ttfts = [r["arrivals"][0] - r["dispatched"]
              for r in accepted if r["arrivals"]]
     tpots: list[float] = []
+    tpots_by_class: dict[str, list[float]] = {"short": [], "long": []}
     for r in accepted:
-        tpots.extend(np.diff(r["arrivals"]))
+        gaps = np.diff(r["arrivals"])
+        tpots.extend(gaps)
+        cls = r["payload"].get("prompt_class", "short")
+        tpots_by_class.setdefault(cls, []).extend(gaps)
+
+    def _p99_ms(xs):
+        return (round(float(np.percentile(xs, 99)) * 1e3, 3)
+                if len(xs) else None)
+
     targets = [s["target_replicas"] for s in status_samples]
     scale_events = sum(1 for a, b in zip(targets, targets[1:]) if a != b)
     return {
@@ -764,6 +805,14 @@ def run_load_bench() -> dict:
             float(np.percentile(ttfts, 99)) * 1e3, 3) if ttfts else None,
         "llm_load_tpot_p99_ms": round(
             float(np.percentile(tpots, 99)) * 1e3, 3) if tpots else None,
+        # decode TPOT split by prompt class: on a co-located fleet the
+        # SHORT class's p99 absorbs the long prompts' prefill stalls;
+        # disaggregation (prefill_replicas > 0) is judged on this number
+        "llm_load_decode_tpot_p99_ms_short": _p99_ms(
+            tpots_by_class.get("short", [])),
+        "llm_load_decode_tpot_p99_ms_long": _p99_ms(
+            tpots_by_class.get("long", [])),
+        "llm_load_prefill_replicas": prefill_replicas,
         "llm_load_lossless": lossless and errors == 0,
         "llm_load_failovers": sum(r["failovers"] for r in results),
         "llm_load_scale_events": scale_events,

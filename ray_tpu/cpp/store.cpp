@@ -224,7 +224,16 @@ class Store {
   uint8_t Delete(const std::string &id) {
     std::unique_lock<std::mutex> lk(mu_);
     auto it = objects_.find(id);
-    if (it == objects_.end()) return ST_NOT_FOUND;
+    if (it == objects_.end()) {
+      // Delete is idempotent AND final even for ids never created here:
+      // tombstoning unknown ids lets sweepers retire object ids whose
+      // producer died before sealing (KV-handoff leak sweep), and the
+      // wakeup below bounces any getter blocked on that id immediately
+      // (ST_EVICTED) instead of letting it sleep out its full timeout.
+      tombstones_.insert(id);
+      sealed_cv_.notify_all();
+      return ST_NOT_FOUND;
+    }
     // Unlink now; clients holding an mmap keep their pages until they unmap.
     if (it->second.spilled) {
       unlink(it->second.spill_path.c_str());
@@ -235,6 +244,9 @@ class Store {
     objects_.erase(it);
     tombstones_.insert(id);
     PushEventLocked(EV_EVICTED, id);
+    // Wake blocked getters so a get racing this delete surfaces
+    // ST_EVICTED promptly rather than hanging until its deadline.
+    sealed_cv_.notify_all();
     return ST_OK;
   }
 
@@ -373,6 +385,9 @@ class Store {
         objects_.erase(it);
         tombstones_.insert(victim);
         PushEventLocked(EV_EVICTED, victim);
+        // getters blocked on the victim learn ST_EVICTED now, not at
+        // their deadline (same contract as Delete)
+        sealed_cv_.notify_all();
         g_counters.Inc("objects_evicted");
         if (g_pressure_log.AbleToRun()) {
           rt_util::Event("INFO", "store_lru_eviction",

@@ -44,6 +44,15 @@ LOG2E = math.log2(math.e)
 LN2 = math.log(2.0)
 
 
+def _tpu_compiler_params(pltpu, **kwargs):
+    """Build TPU compiler params across jax versions: the class was named
+    ``TPUCompilerParams`` before being renamed ``CompilerParams``."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 def mha_reference(
     q: jax.Array,
     k: jax.Array,
@@ -251,7 +260,7 @@ def _flash_forward(
             pltpu.VMEM((g, block_q, 128), jnp.float32),
             pltpu.VMEM((g, block_q, head_dim), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu,
             vmem_limit_bytes=100 * 1024 * 1024,
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
@@ -500,7 +509,7 @@ def _flash_backward(
             pltpu.VMEM((g, block_kv, head_dim), jnp.float32),
             pltpu.VMEM((g, block_kv, head_dim), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu,
             vmem_limit_bytes=100 * 1024 * 1024,
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
@@ -536,7 +545,7 @@ def _flash_backward(
         ),
         out_shape=jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype),
         scratch_shapes=[pltpu.VMEM((g, block_q, head_dim), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu,
             vmem_limit_bytes=100 * 1024 * 1024,
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),

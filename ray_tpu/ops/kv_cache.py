@@ -147,6 +147,29 @@ def _copy_blocks(
     )
 
 
+def _land_blocks(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    blocks: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    # cache_k/v: [n_layer, num_blocks, block_size, H_kv, hd]; blocks: [P];
+    # k_new/v_new: [n_layer, P, block_size, H_kv, hd].
+    return (
+        cache_k.at[:, blocks].set(k_new.astype(cache_k.dtype)),
+        cache_v.at[:, blocks].set(v_new.astype(cache_v.dtype)),
+    )
+
+
+# Disaggregated-handoff landing: scatter externally-produced KV blocks
+# (fetched from the object store by a decode replica) into the paged pool
+# across all layers in one fused op. Callers pad the block-id list to a
+# pow2 bucket with id 0 (the garbage block) and zero payload rows, so the
+# jitted shape set stays closed exactly like ``copy_blocks``.
+land_blocks = jax.jit(_land_blocks)
+
+
 # Copy-on-write block duplication for the prefix cache: when a sequence
 # must append into a block it shares with other sequences (or that is
 # registered in the prefix-cache hash map), the host allocator points the

@@ -55,14 +55,35 @@ def snapshot_is_hot(config: AutoscalingConfig, snap: Mapping) -> bool:
     Hot means the engine itself is saturating: requests wait too long at
     admission, the paged KV pool is nearly spent, deadlines are being
     missed, or admission control is already rejecting.
+
+    ``config.signal_mode`` scopes which signals count — disaggregated
+    prefill/decode pools scale on DISJOINT signals (ROADMAP item 1), so
+    a burst of long cold prompts grows only the prefill pool while KV
+    pressure from long generations grows only the decode pool:
+
+      "prefill": admission-side — queue-wait p95 and rejections (TTFT).
+      "decode":  generation-side — KV pressure, deadline misses, and
+                 (when configured) decode-step p50 (TPOT).
+      "all":     every signal (single-pool serving, the default).
     """
-    if snap.get("queue_wait_p95_s", 0.0) >= config.upscale_queue_wait_p95_s:
-        return True
-    if snap.get("kv_pool_pressure", 0.0) >= config.upscale_kv_pressure:
-        return True
-    if snap.get("deadline_miss_rate", 0.0) > config.upscale_deadline_miss_rate:
-        return True
-    return snap.get("rejection_rate", 0.0) > 0.0
+    mode = getattr(config, "signal_mode", "all")
+    if mode in ("all", "prefill"):
+        if (snap.get("queue_wait_p95_s", 0.0)
+                >= config.upscale_queue_wait_p95_s):
+            return True
+        if snap.get("rejection_rate", 0.0) > 0.0:
+            return True
+    if mode in ("all", "decode"):
+        if snap.get("kv_pool_pressure", 0.0) >= config.upscale_kv_pressure:
+            return True
+        if (snap.get("deadline_miss_rate", 0.0)
+                > config.upscale_deadline_miss_rate):
+            return True
+        p50_bound = getattr(config, "upscale_decode_step_p50_s", None)
+        if (p50_bound is not None
+                and snap.get("decode_step_p50_s", 0.0) >= p50_bound):
+            return True
+    return False
 
 
 def snapshot_is_cold(config: AutoscalingConfig, snap: Mapping) -> bool:

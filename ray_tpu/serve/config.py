@@ -40,6 +40,18 @@ class AutoscalingConfig:
     downscale_kv_pressure: float = 0.5
     # snapshots older than this (on obs.clock) are ignored by aggregation
     signal_ttl_s: float = 5.0
+    # Which saturation signals count toward HOT (disaggregated
+    # prefill/decode pools scale on disjoint signals):
+    #   "all"     — every threshold (the default, single-pool behavior)
+    #   "prefill" — admission-side only: queue-wait p95 + rejections
+    #               (the prefill pool's TTFT story)
+    #   "decode"  — generation-side only: KV pressure + deadline misses
+    #               + optionally decode-step p50 (the TPOT story)
+    # Coldness (scale-down) is mode-independent: idle is idle.
+    signal_mode: str = "all"
+    # decode-step p50 (seconds) above which a "decode"/"all"-mode replica
+    # counts as hot; None disables the check (pressure/misses only)
+    upscale_decode_step_p50_s: float | None = None
 
     def __post_init__(self):
         if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
@@ -53,6 +65,17 @@ class AutoscalingConfig:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
         if self.upscale_queue_wait_p95_s < 0 or self.upscale_deadline_miss_rate < 0:
             raise ValueError("signal thresholds must be >= 0")
+        if self.signal_mode not in ("all", "prefill", "decode"):
+            raise ValueError(
+                "signal_mode must be 'all', 'prefill', or 'decode', got "
+                f"{self.signal_mode!r}"
+            )
+        if (self.upscale_decode_step_p50_s is not None
+                and self.upscale_decode_step_p50_s <= 0):
+            raise ValueError(
+                "upscale_decode_step_p50_s must be positive or None, got "
+                f"{self.upscale_decode_step_p50_s}"
+            )
 
 
 @dataclass
@@ -126,6 +149,18 @@ class DeploymentConfig:
     health_check_period_s: float = 1.0
     graceful_shutdown_timeout_s: float = 5.0
     user_config: dict | None = None
+    # Disaggregated serving role tag ("prefill" | "decode" | None).
+    # Purely observational — the controller keys the
+    # llm_prefill_pool_replicas gauge off it; routing/scaling behavior
+    # comes from the deployment's own autoscaling_config.signal_mode.
+    pool_role: str | None = None
+
+    def __post_init__(self):
+        if self.pool_role not in (None, "prefill", "decode"):
+            raise ValueError(
+                "pool_role must be None, 'prefill', or 'decode', got "
+                f"{self.pool_role!r}"
+            )
 
     @property
     def target_num_replicas(self) -> int:

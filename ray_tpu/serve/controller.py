@@ -131,6 +131,12 @@ class ServeController:
             "Replicas currently draining for graceful scale-down",
             tag_keys=("app", "deployment"),
         )
+        self._m_prefill_pool = metrics.gauge(
+            "llm_prefill_pool_replicas",
+            "Running replicas in a disaggregated prefill pool "
+            "(deployments declaring pool_role='prefill')",
+            tag_keys=("app", "deployment"),
+        )
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconciler"
         )
@@ -634,6 +640,10 @@ class ServeController:
         with self._lock:
             running = sum(1 for r in ds.replicas if r.state == "RUNNING")
             new_status = "HEALTHY" if running >= ds.target else "UPDATING"
+            if getattr(ds.config, "pool_role", None) == "prefill":
+                self._m_prefill_pool.set(
+                    running, tags={"app": app_name, "deployment": name}
+                )
             if new_status != ds.status:
                 ds.status = new_status
                 changed = True

@@ -15,6 +15,7 @@ import random
 import threading
 import time
 import uuid
+import zlib
 from concurrent.futures import Future
 from typing import Any
 
@@ -28,6 +29,26 @@ _TABLE_REFRESH_S = 0.25
 # EngineOverloadedError (draining-replica race, momentary saturation)
 # before failing the half-delivered stream
 _RESUME_OVERLOAD_RETRY_S = 10.0
+# resume-retry backoff schedule (resume_backoff_s): first retry ~base,
+# doubling per attempt up to cap, each jittered into [span/2, span]
+_RESUME_BACKOFF_BASE_S = 0.05
+_RESUME_BACKOFF_CAP_S = 1.0
+
+
+def resume_backoff_s(seed: int, attempt: int, *,
+                     base: float = _RESUME_BACKOFF_BASE_S,
+                     cap: float = _RESUME_BACKOFF_CAP_S) -> float:
+    """Seeded exponential backoff with jitter for the mid-stream RESUME
+    retry loop: attempt N sleeps in [span/2, span] where
+    span = min(cap, base * 2**N). A replica kill failing dozens of
+    streams at once must not re-dispatch them in lockstep — the fixed
+    cadence it replaces hammered the survivor with a thundering herd —
+    so the jitter spreads resumes out while the per-stream seed keeps
+    any one stream's schedule deterministic and testable. The OVERALL
+    retry window (_RESUME_OVERLOAD_RETRY_S) is unchanged."""
+    span = min(cap, base * (2.0 ** min(attempt, 30)))
+    jitter = random.Random((int(seed) << 20) ^ int(attempt)).random()
+    return span * (0.5 + 0.5 * jitter)
 
 
 class DeploymentResponse:
@@ -152,6 +173,14 @@ class ResumableStreamGenerator:
         self.failovers = 0
         self._exclude: set[bytes] = set()
         self._overload_deadline: float | None = None
+        self._overload_attempt = 0
+        # per-stream backoff seed: request_id when the payload carries one
+        # (so a stream's retry schedule is reproducible), else the payload
+        # repr — distinct streams land on distinct jitter either way
+        rid = (payload.get("request_id")
+               if isinstance(payload, dict) else None)
+        self._backoff_seed = zlib.crc32(
+            str(rid if rid is not None else repr(payload)).encode())
 
     def __iter__(self):
         return self
@@ -192,7 +221,9 @@ class ResumableStreamGenerator:
                         raise
                     self._inner = None
                     self._payload = self._resume(list(self.chunks))
-                    time.sleep(0.1)
+                    time.sleep(resume_backoff_s(
+                        self._backoff_seed, self._overload_attempt))
+                    self._overload_attempt += 1
                     continue
                 if (
                     not isinstance(cause, retryable)
@@ -200,6 +231,7 @@ class ResumableStreamGenerator:
                 ):
                     raise
                 self._overload_deadline = None
+                self._overload_attempt = 0
                 self.failovers += 1
                 aid = getattr(self._inner, "replica_actor_id", None)
                 if aid is not None:
@@ -425,7 +457,13 @@ class _Router:
         with self._lock:
             self._inflight[aid] = self._inflight.get(aid, 0) + 1
             self._outstanding[oid] = aid
-        return DeploymentResponse(ref=ref, on_done=lambda: self._decrement(oid))
+        resp = DeploymentResponse(ref=ref, on_done=lambda: self._decrement(oid))
+        # same contract as the stream path: callers running their own
+        # retry loop (e.g. the prefill-handoff seal) need to know which
+        # replica served — or died serving — this call so they can
+        # exclude it on the next attempt
+        resp.replica_actor_id = aid
+        return resp
 
     def broadcast(self, method_name: str, args: tuple = (),
                   kwargs: dict | None = None, timeout: float = 30.0) -> list:

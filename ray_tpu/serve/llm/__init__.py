@@ -11,6 +11,8 @@ closed bucket set so the XLA compile cache stays bounded (arxiv
 - drafter.py  — host-side draft proposal for speculative decoding
 - executor.py — ModelExecutor seam: single-device or tp/fsdp-sharded
 - engine.py   — the continuous-batching scheduler (admission, join/evict)
+- kv_transfer.py — versioned KV-block wire format for the disaggregated
+  prefill→decode handoff over the object plane
 - api.py      — LLMDeployment: the engine as a streaming Serve deployment
 
 See docs/SERVING_LLM.md for the design.
@@ -45,6 +47,11 @@ _EXPORTS = {
     "build_executor": "ray_tpu.serve.llm.executor",
     "KVCacheConfig": "ray_tpu.serve.llm.kv_cache",
     "PagedKVCache": "ray_tpu.serve.llm.kv_cache",
+    "KVLayout": "ray_tpu.serve.llm.kv_transfer",
+    "KVTransferError": "ray_tpu.serve.llm.kv_transfer",
+    "handoff_object_id": "ray_tpu.serve.llm.kv_transfer",
+    "pack_blocks": "ray_tpu.serve.llm.kv_transfer",
+    "unpack_blocks": "ray_tpu.serve.llm.kv_transfer",
 }
 
 __all__ = sorted(_EXPORTS)

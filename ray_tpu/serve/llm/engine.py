@@ -103,7 +103,7 @@ from ray_tpu.exceptions import (
 from ray_tpu.serve._shapes import pad_to_bucket, pow2_buckets
 from ray_tpu.serve.llm import obs
 from ray_tpu.serve.llm.executor import build_executor
-from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache
+from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache, _block_key
 from ray_tpu.util import metrics, tracing
 
 logger = logging.getLogger("ray_tpu.serve.llm")
@@ -705,6 +705,87 @@ class LLMEngine:
             )
             req.out.put(_DONE)
             return True
+
+    # ------------- disaggregated prefill/decode handoff -------------
+
+    def kv_layout(self):
+        """The pool's tensor layout as a ``kv_transfer.KVLayout`` — both
+        sides of a handoff compare these for exact equality before any
+        block moves (a mismatched model/dtype refuses the handoff)."""
+        from ray_tpu.serve.llm.kv_transfer import KVLayout
+
+        c = self.cache.cfg
+        return KVLayout(
+            n_layer=c.n_layer, block_size=c.block_size,
+            n_kv_head=c.n_kv_head, head_dim=c.head_dim,
+            dtype=self.cache.k.dtype.name,
+        )
+
+    def export_prefix(self, prompt) -> list:
+        """PREFILL side of a disaggregated handoff: the resident leading
+        full blocks of ``prompt`` as (chain_digest, k_np, v_np) records
+        in chain order, host-side. Runs under the scheduler lock so no
+        exported block can be LRU-evicted between the chain walk and the
+        device gather; a partial chain (earlier eviction) yields a
+        shorter — still valid — handoff. Call after prefill finished
+        (e.g. a drained max_new_tokens=1 generate), when the prompt's
+        blocks are content-addressed in the prefix cache."""
+        with self._lock:
+            chain = self.cache.export_chain(prompt)
+            if not chain:
+                return []
+            ids = [b for _, b in chain]
+            k, v = self.executor.export_blocks(ids)
+        return [(d, k[:, i], v[:, i]) for i, (d, _) in enumerate(chain)]
+
+    def adopt_prefix(self, prompt, records) -> int:
+        """DECODE side of a handoff: verify each record's chain digest
+        against THIS engine's hash of ``prompt`` (kv_cache._block_key —
+        the payload's self-declared digests are never trusted), claim
+        pool blocks, and land the K/V payloads with one fused scatter.
+        Landed blocks enter the prefix cache as cached (refcount-0)
+        entries, so the follow-up ``submit`` of the same prompt scores a
+        full prefix hit and decodes as if prefilled locally.
+
+        Idempotent and best-effort: already-resident digests are skipped
+        (retries, concurrent identical prompts), a digest mismatch or a
+        full pool stops the walk — earlier blocks still count. Returns
+        the number of leading prompt blocks resident afterwards."""
+        bs = self.cache.cfg.block_size
+        with self._lock:
+            # Cap adoptions at the spare (unreserved) capacity: landing
+            # into reserved headroom is wasted motion — the admissions
+            # holding those reservations would evict the fresh blocks
+            # before the follow-up submit could hit them.
+            budget = self.cache.spare_blocks
+            digest = b""
+            ids: list[int] = []
+            ks: list[np.ndarray] = []
+            vs: list[np.ndarray] = []
+            resident = 0
+            for i, (chain, k_blk, v_blk) in enumerate(records):
+                if (i + 1) * bs > len(prompt):
+                    break  # record beyond the prompt's full blocks
+                digest = _block_key(digest, prompt[i * bs:(i + 1) * bs])
+                if digest != chain:
+                    break  # not our tokens from position 0 — refuse
+                if self.cache.has_digest(digest):
+                    resident += 1
+                    continue
+                if len(ids) >= budget:
+                    break  # only reserved headroom left — partial is fine
+                b = self.cache.adopt_block(digest)
+                if b is None:
+                    break  # pool has no claimable block — partial is fine
+                ids.append(b)
+                ks.append(k_blk)
+                vs.append(v_blk)
+                resident += 1
+            if ids:
+                self.executor.land_blocks(
+                    ids, np.stack(ks, axis=1), np.stack(vs, axis=1)
+                )
+        return resident
 
     def stats(self) -> dict:
         with self._lock:

@@ -56,6 +56,17 @@ def _host_tokens(tokens) -> np.ndarray:
     return np.asarray(tokens, np.int32)
 
 
+def _host_blocks(kv) -> np.ndarray:
+    """The SECOND allowed device->host sync, off the emit path entirely:
+    materialize a handful of finished KV blocks for a disaggregated
+    prefill handoff (serve/llm/kv_transfer.py wire format). This runs
+    once per handed-off request on the PREFILL replica — never inside
+    the decode scheduler loop — and moves O(blocks) cache bytes, which
+    is the whole point of the transfer. Allowlisted by name in
+    tests/test_sanitizers.py next to ``_host_tokens``."""
+    return np.asarray(kv)
+
+
 class ModelExecutor:
     """Device-side half of the LLM engine.
 
@@ -194,6 +205,57 @@ class ModelExecutor:
             dst[i] = d
         self.cache.k, self.cache.v = copy_blocks(
             self.cache.k, self.cache.v, self._dev(src), self._dev(dst)
+        )
+
+    def export_blocks(
+        self, block_ids: list[int]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Materialize the given physical blocks host-side for a
+        disaggregated handoff: returns (k, v) each
+        [n_layer, len(block_ids), block_size, H_kv, hd] numpy, in the
+        given order. The gather pads to a pow2 bucket with block 0 so
+        the traced shape set stays closed (same discipline as
+        ``copy_blocks``); padding rows are sliced off host-side. On a
+        mesh the gather output is unsharded along heads by the transfer
+        itself — the wire format is mesh-agnostic."""
+        if not block_ids:
+            n_layer = self.cache.k.shape[0]
+            shape = (n_layer, 0) + tuple(self.cache.k.shape[2:])
+            empty = np.zeros(shape, np.float32)
+            return empty, empty
+        width = 1 << (len(block_ids) - 1).bit_length()
+        ids = np.zeros((width,), np.int32)
+        for i, b in enumerate(block_ids):
+            ids[i] = b
+        k = _host_blocks(self.cache.k[:, self._dev(ids)])
+        v = _host_blocks(self.cache.v[:, self._dev(ids)])
+        return k[:, : len(block_ids)], v[:, : len(block_ids)]
+
+    def land_blocks(
+        self, block_ids: list[int], k_new: np.ndarray, v_new: np.ndarray
+    ) -> None:
+        """Scatter externally-produced KV blocks (a fetched handoff
+        payload) into this executor's pool at ``block_ids``, all layers
+        fused (ops/kv_cache.land_blocks). Pads the id list to a pow2
+        bucket targeting garbage block 0 with zero payload rows, so the
+        jitted shape set stays closed."""
+        if not block_ids:
+            return
+        from ray_tpu.ops.kv_cache import land_blocks
+
+        width = 1 << (len(block_ids) - 1).bit_length()
+        ids = np.zeros((width,), np.int32)
+        for i, b in enumerate(block_ids):
+            ids[i] = b
+        if width != len(block_ids):
+            pad = ((0, 0), (0, width - len(block_ids))) + tuple(
+                (0, 0) for _ in range(k_new.ndim - 2)
+            )
+            k_new = np.pad(k_new, pad)
+            v_new = np.pad(v_new, pad)
+        self.cache.k, self.cache.v = land_blocks(
+            self.cache.k, self.cache.v, self._dev(ids),
+            self._dev(k_new), self._dev(v_new),
         )
 
     def sync_tokens(self, tokens_dev) -> np.ndarray:

@@ -87,6 +87,7 @@ class CacheStats:
     prefix_hit_tokens: int = 0
     prefix_evicted_blocks: int = 0
     cow_copies: int = 0
+    adopted_blocks: int = 0  # handoff blocks landed from another replica
     tables: dict = field(default_factory=dict)
 
 
@@ -137,6 +138,13 @@ class PagedKVCache:
     def available_blocks(self) -> int:
         """Blocks an admission may claim: truly free + evictable cached."""
         return len(self._free) + len(self._lru)
+
+    @property
+    def spare_blocks(self) -> int:
+        """Claimable blocks beyond outstanding reservations — the most a
+        handoff landing can adopt without live admissions immediately
+        evicting the freshly-landed payloads back out of the pool."""
+        return max(0, self.available_blocks - self._reserved)
 
     def can_reserve(self, n_blocks: int) -> bool:
         return n_blocks <= self.available_blocks - self._reserved
@@ -276,6 +284,54 @@ class PagedKVCache:
                 break
             hits += 1
         return hits
+
+    def export_chain(self, tokens) -> list[tuple[bytes, int]]:
+        """(chain digest, physical block) for each LEADING full block of
+        ``tokens`` currently resident — ``peek_prefix`` that also names
+        the blocks. The prefill side of a disaggregated handoff walks
+        this to know WHICH pool blocks to ship and under which chain
+        digests; a partial walk (some blocks already evicted) is still a
+        valid, shorter handoff."""
+        digest = b""
+        bs = self.cfg.block_size
+        out: list[tuple[bytes, int]] = []
+        for i in range(len(tokens) // bs):
+            digest = _block_key(digest, tokens[i * bs:(i + 1) * bs])
+            b = self._hash_to_block.get(digest)
+            if b is None:
+                break
+            out.append((digest, b))
+        return out
+
+    def has_digest(self, digest: bytes) -> bool:
+        """Whether a chain digest is resident (referenced or cached) —
+        lets the handoff landing path tell 'already here, skip' apart
+        from 'pool full, stop' when ``adopt_block`` returns None."""
+        return digest in self._hash_to_block
+
+    def adopt_block(self, digest: bytes) -> int | None:
+        """Claim one block for a handoff landing and content-address it
+        under ``digest`` as a CACHED (refcount-0, LRU) entry — after the
+        caller scatters the fetched payload into the returned id, a
+        plain ``assign_prefix`` scores a local prefix hit on it.
+
+        Idempotent and best-effort by design (the handoff retry state
+        machine re-drives): returns None without side effects when the
+        digest is already resident (a concurrent identical prompt — or
+        this same handoff, retried) or when the pool has no claimable
+        block. Adoption moves a block free -> cached (or recycles a
+        cached one), so ``available_blocks`` — and therefore admission
+        accounting — is unchanged."""
+        if digest in self._hash_to_block:
+            return None
+        if not self._free and not self._lru:
+            return None
+        b = self._take_block(reserved=False)
+        self._hash_to_block[digest] = b
+        self._block_hash[b] = digest
+        self._lru[b] = None  # MRU end: just-landed blocks evict last
+        self.stats.adopted_blocks += 1
+        return b
 
     def assign_prefix(self, seq_id, tokens, max_blocks: int | None = None) -> int:
         """Map the longest resident prefix of ``tokens`` (full blocks
@@ -431,6 +487,7 @@ class PagedKVCache:
             "prefix_hit_tokens": s.prefix_hit_tokens,
             "prefix_evicted_blocks": s.prefix_evicted_blocks,
             "cow_copies": s.cow_copies,
+            "adopted_blocks": s.adopted_blocks,
         }
 
     def num_allocated(self, seq_id) -> int:

@@ -1,0 +1,210 @@
+"""Versioned KV-block wire format for disaggregated prefill/decode.
+
+A prefill replica finishes chunked prefill, exports the request's full
+prompt blocks from its paged pool, and packs them with this module into
+ONE contiguous byte payload that is sealed into the shared-memory
+object store (``ray_tpu.put`` path).  The decode replica fetches the
+payload, verifies integrity, and lands the blocks into its own pool via
+the fused scatter in ``ops.kv_cache.land_blocks``.
+
+Wire layout (all integers little-endian):
+
+    MAGIC   4 bytes   b"RTKV"
+    VERSION u16       wire version (bump on any layout change)
+    HLEN    u32       length of the JSON header that follows
+    HEADER  HLEN      json: {n_layer, block_size, n_kv_head, head_dim,
+                             dtype, num_blocks, prefix_tokens}
+    then, per block, in chain order:
+      CHAIN   16 bytes  blake2b-16 token-chain digest (PR-3 prefix
+                        machinery) — lets the decode side verify the
+                        block corresponds to ITS tokenization of the
+                        prompt before adopting it
+      CONTENT 16 bytes  blake2b-16 over the raw k||v payload bytes —
+                        catches corruption/truncation in transit
+      K       n_layer*block_size*n_kv_head*head_dim * itemsize bytes
+      V       same size
+
+Integrity is layered: the header pins the tensor layout (a mismatched
+mesh/model simply refuses the handoff), the chain digest pins *which
+tokens* each block encodes, and the content digest pins the bytes.  Any
+mismatch raises :class:`KVTransferError` — callers treat that exactly
+like a lost object and fall back to local prefill; a torn handoff must
+never become a corrupted stream.
+
+This module is deliberately device-free: it only ever touches numpy
+arrays the executor has already synced host-side (``np.frombuffer`` /
+``ndarray.tobytes`` — no ``np.asarray`` on device values), so the
+serve/llm host-sync lint applies to it unchanged.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from ray_tpu._private.ids import ObjectID
+
+MAGIC = b"RTKV"
+WIRE_VERSION = 1
+_DIGEST = 16  # blake2b digest_size, matches kv_cache._block_key
+_HDR = struct.Struct("<4sHI")
+
+
+class KVTransferError(RuntimeError):
+    """A KV handoff payload failed validation (layout / digest / size).
+
+    Treated by the decode side exactly like a lost object: re-prefill
+    locally rather than decode from suspect blocks.
+    """
+
+
+@dataclass(frozen=True)
+class KVLayout:
+    """Tensor layout a handoff payload was packed under.  Both sides
+    must agree exactly — blocks from a different model/mesh shape are
+    not landable."""
+
+    n_layer: int
+    block_size: int
+    n_kv_head: int
+    head_dim: int
+    dtype: str
+
+    @property
+    def block_bytes(self) -> int:
+        n = self.n_layer * self.block_size * self.n_kv_head * self.head_dim
+        return n * np.dtype(_resolve_dtype(self.dtype)).itemsize
+
+
+def _resolve_dtype(name: str):
+    """Resolve a dtype name to something numpy can address, including
+    the ML dtypes (bfloat16) jax registers via ml_dtypes."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def handoff_object_id(request_id: str, attempt: int) -> ObjectID:
+    """Deterministic object id for one handoff attempt.
+
+    Determinism is what makes the retry state machine idempotent: a
+    re-driven seal for the same (request, attempt) writes the same id
+    (put is idempotent on ST_EXISTS), and the client can leak-sweep
+    every attempt id it ever derived without having heard back from a
+    killed prefill replica.
+    """
+    h = hashlib.blake2b(
+        f"kvxfer:{request_id}:{attempt}".encode(), digest_size=ObjectID.SIZE
+    )
+    return ObjectID(h.digest())
+
+
+def pack_blocks(
+    layout: KVLayout,
+    records: list[tuple[bytes, np.ndarray, np.ndarray]],
+    *,
+    prefix_tokens: int,
+) -> bytes:
+    """Pack ``records`` — (chain_digest, k_block, v_block) in chain
+    order — into one wire payload.  Each k/v block has shape
+    [n_layer, block_size, n_kv_head, head_dim]."""
+    header = {
+        "n_layer": layout.n_layer,
+        "block_size": layout.block_size,
+        "n_kv_head": layout.n_kv_head,
+        "head_dim": layout.head_dim,
+        "dtype": layout.dtype,
+        "num_blocks": len(records),
+        "prefix_tokens": prefix_tokens,
+    }
+    hjson = json.dumps(header, sort_keys=True).encode()
+    parts = [_HDR.pack(MAGIC, WIRE_VERSION, len(hjson)), hjson]
+    for chain_digest, k_block, v_block in records:
+        if len(chain_digest) != _DIGEST:
+            raise KVTransferError(
+                f"chain digest must be {_DIGEST} bytes, got "
+                f"{len(chain_digest)}"
+            )
+        payload = k_block.tobytes() + v_block.tobytes()
+        if len(payload) != 2 * layout.block_bytes:
+            raise KVTransferError(
+                f"block payload is {len(payload)} bytes, layout says "
+                f"{2 * layout.block_bytes}"
+            )
+        content = hashlib.blake2b(payload, digest_size=_DIGEST).digest()
+        parts.append(chain_digest)
+        parts.append(content)
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def unpack_blocks(
+    wire: bytes,
+) -> tuple[KVLayout, int, list[tuple[bytes, np.ndarray, np.ndarray]]]:
+    """Parse and verify a wire payload.
+
+    Returns (layout, prefix_tokens, records) where records are
+    (chain_digest, k_block, v_block) in chain order.  Raises
+    :class:`KVTransferError` on any structural or digest mismatch —
+    the caller falls back to local prefill.
+    """
+    if len(wire) < _HDR.size:
+        raise KVTransferError("payload shorter than wire header")
+    magic, version, hlen = _HDR.unpack_from(wire, 0)
+    if magic != MAGIC:
+        raise KVTransferError(f"bad magic {magic!r}")
+    if version != WIRE_VERSION:
+        raise KVTransferError(
+            f"wire version {version} != supported {WIRE_VERSION}"
+        )
+    off = _HDR.size
+    if len(wire) < off + hlen:
+        raise KVTransferError("truncated header")
+    try:
+        header = json.loads(wire[off:off + hlen])
+    except ValueError as e:
+        raise KVTransferError(f"undecodable header: {e}") from e
+    off += hlen
+    try:
+        layout = KVLayout(
+            n_layer=int(header["n_layer"]),
+            block_size=int(header["block_size"]),
+            n_kv_head=int(header["n_kv_head"]),
+            head_dim=int(header["head_dim"]),
+            dtype=str(header["dtype"]),
+        )
+        num_blocks = int(header["num_blocks"])
+        prefix_tokens = int(header["prefix_tokens"])
+    except (KeyError, ValueError) as e:
+        raise KVTransferError(f"malformed header: {e}") from e
+    block_bytes = layout.block_bytes
+    rec_size = 2 * _DIGEST + 2 * block_bytes
+    if len(wire) != off + num_blocks * rec_size:
+        raise KVTransferError(
+            f"payload size {len(wire)} != expected "
+            f"{off + num_blocks * rec_size} for {num_blocks} blocks"
+        )
+    dtype = _resolve_dtype(layout.dtype)
+    shape = (layout.n_layer, layout.block_size, layout.n_kv_head,
+             layout.head_dim)
+    records: list[tuple[bytes, np.ndarray, np.ndarray]] = []
+    for i in range(num_blocks):
+        chain = wire[off:off + _DIGEST]
+        off += _DIGEST
+        content = wire[off:off + _DIGEST]
+        off += _DIGEST
+        payload = wire[off:off + 2 * block_bytes]
+        off += 2 * block_bytes
+        got = hashlib.blake2b(payload, digest_size=_DIGEST).digest()
+        if got != content:
+            raise KVTransferError(f"content digest mismatch on block {i}")
+        k = np.frombuffer(payload[:block_bytes], dtype=dtype).reshape(shape)
+        v = np.frombuffer(payload[block_bytes:], dtype=dtype).reshape(shape)
+        records.append((chain, k, v))
+    return layout, prefix_tokens, records

@@ -61,7 +61,14 @@ class Checkpoint:
 
         ckptr = ocp.PyTreeCheckpointer()
         meta = ckptr.metadata(path)
-        tree = meta.item_metadata.tree if hasattr(meta, "item_metadata") else meta.tree
+        # orbax metadata API drift: newer versions hand back the raw tree
+        # (a dict), older ones wrap it in (item_)metadata objects
+        if isinstance(meta, dict):
+            tree = meta
+        elif hasattr(meta, "item_metadata"):
+            tree = meta.item_metadata.tree
+        else:
+            tree = meta.tree
         restore_args = jax.tree.map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray),
             tree,

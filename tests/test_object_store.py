@@ -131,3 +131,112 @@ def test_stats(store):
     client, _ = store
     s = client.stats()
     assert s["capacity_bytes"] == 8 * 1024 * 1024
+
+
+def test_delete_unknown_id_tombstones(store):
+    """Delete is idempotent and FINAL: deleting an id that was never
+    created still tombstones it, so a later get reports EVICTED instead
+    of blocking to its deadline. The KV-handoff sweep relies on this —
+    it retires every attempt id, including attempts whose prefill
+    replica died before sealing anything."""
+    client, _ = store
+    oid = _oid()
+    client.delete(oid)
+    assert client.get(oid, timeout_ms=0) is EVICTED
+
+
+def test_blocked_get_wakes_promptly_on_delete(store):
+    """A getter blocked on a not-yet-sealed object must be woken by a
+    racing delete and surface EVICTED in one round-trip — not sleep out
+    its full timeout. (Regression: the daemon only notified the seal cv
+    on Seal, so delete left getters sleeping to deadline.)"""
+    client, sock = store
+    getter = ObjectStoreClient(sock)
+    oid = _oid()
+    result = {}
+
+    def blocked_get():
+        t0 = time.monotonic()
+        result["value"] = getter.get(oid, timeout_ms=30_000)
+        result["elapsed"] = time.monotonic() - t0
+
+    t = threading.Thread(target=blocked_get)
+    t.start()
+    time.sleep(0.3)  # let the getter block in the daemon
+    client.delete(oid)
+    t.join(timeout=10)
+    assert not t.is_alive(), "getter still blocked after delete"
+    assert result["value"] is EVICTED
+    assert result["elapsed"] < 10.0, (
+        f"get slept {result['elapsed']:.1f}s past the delete"
+    )
+
+
+def test_recreate_after_delete(store):
+    """Create clears the tombstone: an id deleted (e.g. swept) can be
+    created and sealed again — handoff attempt ids are deterministic, so
+    a retry after an aggressive sweep must not be bricked."""
+    client, _ = store
+    oid = _oid()
+    client.delete(oid)
+    buf = client.create(oid, 3)
+    buf[:] = b"new"
+    client.seal(oid)
+    assert bytes(client.get(oid, timeout_ms=1000)) == b"new"
+
+
+def test_get_chaos_point_fires():
+    """``object_store.get`` is a chaos hook site: a raise-action fault
+    there surfaces before any socket traffic, which is how the handoff
+    chaos tests simulate a lost store fetch."""
+    from ray_tpu._private import chaos
+
+    chaos.install(chaos.FaultPlan(faults=(
+        chaos.Fault(point="object_store.get", action="raise", times=1),
+    )))
+    try:
+        client = ObjectStoreClient.__new__(ObjectStoreClient)  # no daemon
+        with pytest.raises(chaos.ChaosFault):
+            client.get(_oid(), timeout_ms=0)
+    finally:
+        chaos.clear()
+
+
+def test_gc_stale_segments_on_store_start(tmp_path):
+    """An rt_store shm segment orphaned by a dead daemon (crash/teardown
+    race) is unlinked when a fresh store starts; segments of live
+    processes are left alone."""
+    import subprocess
+
+    from ray_tpu._private.object_store import _gc_stale_segments
+
+    if not os.path.isdir("/dev/shm") or not os.access("/dev/shm", os.W_OK):
+        pytest.skip("no writable /dev/shm")
+    # a pid guaranteed dead: a subprocess we already reaped
+    p = subprocess.Popen(["true"])
+    p.wait()
+    dead = f"/dev/shm/rt_store_{p.pid}_7"
+    live = f"/dev/shm/rt_store_{os.getpid()}_7"
+    junk = "/dev/shm/rt_store_notapid"
+    for path in (dead, live, junk):
+        with open(path, "wb") as f:
+            f.write(b"x")
+    try:
+        sock = str(tmp_path / "gc.sock")
+        proc = start_store(sock, 1024 * 1024)  # start_store runs the GC
+        try:
+            assert not os.path.exists(dead), "dead-pid segment not swept"
+            assert os.path.exists(live), "live-pid segment wrongly swept"
+            assert os.path.exists(junk), "unparseable name wrongly swept"
+        finally:
+            ObjectStoreClient(sock).shutdown_store()
+            proc.wait(timeout=5)
+        # direct call is idempotent on an already-clean tree
+        _gc_stale_segments()
+        assert os.path.exists(live)
+    finally:
+        for path in (dead, live, junk):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass

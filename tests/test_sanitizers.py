@@ -136,9 +136,10 @@ def test_device_values_cross_host_only_in_host_tokens():
     Python ints it already has (``drafter.py`` must stay device-free)
     and the verify step's packed verdicts come back through the same
     ``_host_tokens`` funnel (``executor.sync_verify``). Allowlist:
-    ``_host_tokens`` (THE sync point) and kv_cache's ``_block_key``
-    (hashes host-side Python int lists — never touches a device
-    value)."""
+    ``_host_tokens`` (THE sync point), ``_host_blocks`` (the
+    disaggregated-handoff KV export — an explicit bulk pull OFF the
+    emit path, ISSUE 11), and kv_cache's ``_block_key`` (hashes
+    host-side Python int lists — never touches a device value)."""
     import ast
     import pathlib
 
@@ -156,7 +157,11 @@ def test_device_values_cross_host_only_in_host_tokens():
     assert any(p.name == "drafter.py" for p in targets), (
         "drafter.py missing from serve/llm lint targets"
     )
-    allowed = {("executor.py", "_host_tokens"), ("kv_cache.py", "_block_key")}
+    allowed = {
+        ("executor.py", "_host_tokens"),
+        ("executor.py", "_host_blocks"),
+        ("kv_cache.py", "_block_key"),
+    }
 
     offenders = []
     for path in targets:
@@ -199,6 +204,80 @@ def test_device_values_cross_host_only_in_host_tokens():
     assert not offenders, (
         f"device->host sync outside executor._host_tokens: {offenders}"
     )
+
+
+def test_handoff_retry_paths_never_swallow_silently():
+    """Failure-semantics lint (ISSUE 11): the KV-handoff state machine is
+    built out of typed ``except`` fallbacks — seal retries on a survivor,
+    fetch falls back to decode-local prefill, sweeps shrug off a dead
+    store — and each one is only safe because the failure is OBSERVABLE.
+    An except handler in those retry paths that neither re-raises nor
+    logs turns a chaos fault into a silent behavior change (the stream
+    still completes, so nothing downstream notices the handoff quietly
+    stopped working). Every handler in the handoff functions (api.py)
+    and the mid-stream RESUME loop (handle.py — outside serve/llm, so
+    the serving-path bare-except lint doesn't reach it) must contain a
+    ``raise`` or a logging/metrics call; handle.py additionally must
+    have no bare excepts anywhere."""
+    import ast
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    observable_attrs = {
+        "debug", "info", "warning", "error", "exception", "critical",  # log
+        "inc", "set", "observe",  # metrics
+    }
+    scopes = {
+        root / "ray_tpu" / "serve" / "llm" / "api.py": frozenset({
+            "prefill_export", "_sweep_sealed", "_land_handoff",
+            "_seal_handoff", "_sweep_attempts",
+        }),
+        root / "ray_tpu" / "serve" / "handle.py": frozenset({
+            "__next__", "resume_backoff_s",
+        }),
+    }
+    offenders = []
+    for path, fns in scopes.items():
+        src = path.read_text()
+        # the scoped functions must exist — a rename would un-lint them
+        for fn in fns - {"resume_backoff_s", "__next__"}:
+            assert f"def {fn}(" in src, f"{path.name} lost {fn}()"
+        tree = ast.parse(src, filename=str(path))
+        chains: dict[ast.AST, frozenset] = {}
+
+        def tag(node, chain):
+            for child in ast.iter_child_nodes(node):
+                c = chain
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    c = chain | {child.name}
+                chains[child] = c
+                tag(child, c)
+
+        tag(tree, frozenset())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if path.name == "handle.py" and node.type is None:
+                offenders.append(
+                    f"{path.relative_to(root)}:{node.lineno} (bare except)")
+                continue
+            if not (chains.get(node, frozenset()) & fns):
+                continue
+            observable = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Raise):
+                    observable = True
+                    break
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in observable_attrs):
+                    observable = True
+                    break
+            if not observable:
+                offenders.append(
+                    f"{path.relative_to(root)}:{node.lineno} "
+                    "(handler neither raises nor logs)")
+    assert not offenders, f"silent drops in handoff retry paths: {offenders}"
 
 
 def test_one_clock_in_llm_serving_path():

@@ -97,7 +97,8 @@ def test_sharded_executor_shards_kv_pool_head_axis(jax_cpu):
     st = eng.stats()
     assert st["executor"] == {"executor": "sharded", "devices": 4,
                               "mesh": {"tp": 2, "fsdp": 2},
-                              "attention_backend": "xla"}
+                              "attention_backend": "xla",
+                              "speculative": None}
     assert eng.debug_dump()["executor"]["mesh"] == {"tp": 2, "fsdp": 2}
 
 
@@ -110,7 +111,8 @@ def test_single_device_default_unchanged(jax_cpu):
     assert isinstance(eng.executor, SingleDeviceExecutor)
     assert eng.stats()["executor"] == {"executor": "single", "devices": 1,
                                        "mesh": None,
-                                       "attention_backend": "xla"}
+                                       "attention_backend": "xla",
+                                       "speculative": None}
     assert len(eng.generate([5, 6, 7], max_new_tokens=4)) == 4
 
 
