@@ -23,6 +23,20 @@ Hook sites currently instrumented:
                         (context: active — in-flight stream count)
   ``controller_scale``— before the controller applies a replica-count
                         change (context: app, deployment, current, target)
+  ``controller.checkpoint`` — in the Serve controller, before each
+                        crash-recovery checkpoint write to the GCS KV
+                        (context: reason, seq — ``raise`` here proves the
+                        warn-and-retry degradation)
+  ``controller.kill`` — in the Serve controller, after a SUCCESSFUL
+                        checkpoint write (context: reason — e.g.
+                        ``{"reason": "drain_start"}`` kills mid-drain)
+                        and in the replica-created-but-not-yet-
+                        checkpointed window (reason: replica_starting,
+                        context also: deployment — the deterministic
+                        orphan-replica site)
+  ``controller.recover`` — top of the restarted controller's _recover()
+                        (``delay`` here stretches the outage window so
+                        tests can probe the data plane mid-outage)
   ``llm.handoff.seal`` — on a prefill replica after prefill, before the
                         KV blocks are exported/sealed into the object
                         store (context: request_id, attempt, tag —

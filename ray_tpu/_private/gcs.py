@@ -724,3 +724,42 @@ class GcsService:
         if p and p.get("job_id"):
             events = [e for e in events if e.get("job_id") == p["job_id"]]
         return {"events": events}
+
+
+# ---------------- client-side internal-KV helpers ----------------
+#
+# The internal KV has always been server-complete (rpc_kv_* above,
+# persisted with the rest of the GCS tables when the store is durable)
+# but had no Python client path; the Serve controller's crash-recovery
+# checkpoints are the first consumer (reference:
+# gcs_kv_manager.h:138 InternalKVInterface — every Ray component stores
+# restart-survivable state there rather than in process memory).
+# Keys and values are bytes on the wire; ``ns`` scopes independent
+# consumers into separate keyspaces.
+
+
+def kv_put(key: bytes, value: bytes, *, ns: str = "default") -> bool:
+    """Store ``key`` -> ``value`` in the GCS internal KV. One RPC, one
+    atomic dict assignment server-side — a reader sees the old value or
+    the new one, never a torn write. Returns True when the key is new."""
+    from ray_tpu._private.worker import global_worker
+
+    r = global_worker().gcs.call(
+        "kv_put", {"key": key, "value": value, "ns": ns, "overwrite": True}
+    )
+    return bool(r.get("added"))
+
+
+def kv_get(key: bytes, *, ns: str = "default") -> bytes | None:
+    """Fetch a value from the GCS internal KV (None when absent)."""
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().gcs.call("kv_get", {"key": key, "ns": ns})["value"]
+
+
+def kv_del(key: bytes, *, ns: str = "default") -> bool:
+    """Delete a key from the GCS internal KV; True if it existed."""
+    from ray_tpu._private.worker import global_worker
+
+    r = global_worker().gcs.call("kv_del", {"key": key, "ns": ns})
+    return bool(r.get("deleted"))

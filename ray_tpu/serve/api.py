@@ -22,12 +22,26 @@ _proxy: HTTPProxy | None = None
 _grpc_proxy: GrpcProxy | None = None
 
 
+# the raylet is the controller's supervisor: on worker death it restarts
+# the named actor IN PLACE (same actor id — cached handles keep working)
+# and __init__ -> _recover() rebuilds state from the GCS checkpoint.
+# Dead-dead (restart budget exhausted) falls back to this module creating
+# a fresh actor, which recovers from the same checkpoint; handles pick up
+# the new actor id via _Router._invalidate_controller's re-resolve.
+_CONTROLLER_MAX_RESTARTS = 100
+
+
 def _get_or_create_controller():
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
         pass
-    handle = ActorClass(ServeController, num_cpus=0.1, name=CONTROLLER_NAME).remote()
+    handle = ActorClass(
+        ServeController,
+        num_cpus=0.1,
+        name=CONTROLLER_NAME,
+        max_restarts=_CONTROLLER_MAX_RESTARTS,
+    ).remote()
     # wait for liveness so the first deploy call doesn't race startup
     ray_tpu.get(handle.list_applications.remote(), timeout=60)
     return handle

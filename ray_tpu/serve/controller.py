@@ -6,23 +6,49 @@ deployment_state.py:1155,2258 replica-set reconciler state machine;
 application_state.py app lifecycle; autoscaling decisions fed by replica
 metrics). One named actor; a background thread drives reconciliation:
 desired replicas vs. live replicas, health checks, autoscaling.
+
+Crash restartability (reference: the controller checkpoints to the GCS
+internal KV and `_recover_state_from_checkpoint` on boot): after every
+state mutation the controller writes a small versioned JSON checkpoint
+of desired state + replica roster to the GCS internal KV; the raylet
+restarts the named actor in place on worker death (api.py spawns it
+with max_restarts > 0), and ``_recover`` rebuilds from the checkpoint —
+adopting live replicas through the normal ping path, reaping orphans
+the checkpoint doesn't know, and resuming in-flight drains. The data
+plane (handles/proxies) keeps serving from cached routing tables for
+the duration of the outage.
 """
 from __future__ import annotations
 
+import base64
+import json
+import logging
 import threading
 import time
 from typing import Any
 
 import ray_tpu
-from ray_tpu._private import chaos
-from ray_tpu.actor import ActorClass
+from ray_tpu._private import chaos, serialization
+from ray_tpu._private.gcs import kv_del, kv_get, kv_put
+from ray_tpu._private.ids import ActorID
+from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.serve.autoscaling_policy import AutoscalingDecider, fleet_saturated
 from ray_tpu.serve.config import DeploymentConfig
 from ray_tpu.serve.llm import obs
 from ray_tpu.serve.replica import ReplicaActor
 from ray_tpu.util import metrics
 
+logger = logging.getLogger("ray_tpu.serve.controller")
+
 CONTROLLER_NAME = "RT_SERVE_CONTROLLER"
+# crash-recovery checkpoint location in the GCS internal KV
+CHECKPOINT_KEY = b"RT_SERVE_CONTROLLER_CKPT"
+CHECKPOINT_NS = "serve"
+# bump on ANY incompatible change to the checkpoint payload shape;
+# decode_checkpoint refuses (loudly) to recover from a version it does
+# not understand — guessing at an unknown layout could adopt or reap
+# the wrong replicas
+CHECKPOINT_VERSION = 1
 _METRIC_TTL_S = 5.0
 # cadence of per-replica autoscaling_snapshot pulls (signal-capable
 # deployments only) and the patience per pull
@@ -33,6 +59,56 @@ _SNAPSHOT_TIMEOUT_S = 30.0
 # data plane running at full concurrency — a saturated replica must still
 # report that it IS saturated
 _CONTROL_SLOTS = 3
+
+
+# ---------------- checkpoint codec ----------------
+#
+# The checkpoint is a small JSON envelope (human-inspectable via
+# `kv_get`) with one non-JSON island: each deployment spec carries a
+# pickled callable_blob / init_args, so specs ride base64(pickle)
+# inside the envelope. Encoding is pure — unit-testable without a
+# controller or a cluster.
+
+
+def encode_spec(spec: dict) -> str:
+    """Deployment spec -> base64 text safe to embed in the JSON envelope
+    (specs hold bytes blobs and dataclasses JSON can't carry)."""
+    return base64.b64encode(serialization.dumps(spec)).decode("ascii")
+
+
+def decode_spec(blob: str) -> dict:
+    return serialization.deserialize(base64.b64decode(blob))
+
+
+def encode_checkpoint(payload: dict) -> bytes:
+    return json.dumps(payload, separators=(",", ":")).encode()
+
+
+def decode_checkpoint(blob: bytes) -> dict:
+    """Parse + validate a checkpoint. Raises ValueError on an unknown
+    version or a structurally broken payload — recovery must refuse to
+    guess (a misread roster would reap live replicas as orphans)."""
+    try:
+        payload = json.loads(blob.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"serve controller checkpoint is not JSON: {e}")
+    if not isinstance(payload, dict):
+        raise ValueError(
+            f"serve controller checkpoint must be a JSON object, "
+            f"got {type(payload).__name__}"
+        )
+    version = payload.get("version")
+    if version != CHECKPOINT_VERSION:
+        raise ValueError(
+            f"serve controller checkpoint version {version!r} is not "
+            f"supported (this binary speaks version {CHECKPOINT_VERSION})"
+        )
+    for field in ("seq", "apps"):
+        if field not in payload:
+            raise ValueError(
+                f"serve controller checkpoint missing field {field!r}"
+            )
+    return payload
 
 
 class _ReplicaState:
@@ -137,6 +213,39 @@ class ServeController:
             "(deployments declaring pool_role='prefill')",
             tag_keys=("app", "deployment"),
         )
+        self._m_restarts = metrics.counter(
+            "serve_controller_restarts_total",
+            "Controller boots that recovered state from a checkpoint "
+            "(i.e. crash restarts; a fresh start does not count)",
+        )
+        self._m_recovery = metrics.histogram(
+            "serve_controller_recovery_seconds",
+            "Wall time of _recover(): checkpoint read -> state rebuilt, "
+            "replicas adopted, orphans reaped",
+            boundaries=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0),
+        )
+        self._m_orphans = metrics.counter(
+            "serve_orphan_replicas_reaped",
+            "Live replica actors killed at recovery because the "
+            "checkpoint did not know them (mutation crashed before its "
+            "checkpoint landed, or their app was deleted mid-outage)",
+        )
+        # crash-recovery checkpointing: _ckpt_io_lock serializes writers
+        # (RPC threads + reconciler) so a slow write can't be overtaken
+        # by a staler snapshot; _ckpt_dirty marks a failed write for the
+        # reconcile loop to retry
+        self._ckpt_io_lock = threading.Lock()
+        self._ckpt_seq = 0
+        self._ckpt_dirty = False
+        self._restarts = 0
+        self._recovered_at: float | None = None
+        self._recovery_s: float | None = None
+        try:
+            self._recover()
+        except Exception:  # noqa: BLE001 — recovery must never brick boot
+            logger.exception(
+                "serve controller recovery failed; starting fresh"
+            )
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconciler"
         )
@@ -189,6 +298,7 @@ class ServeController:
             self._version += 1
         for d in removed:
             self._stop_replicas(d, len(d.replicas))
+        self._checkpoint("deploy")
 
     def delete_application(self, app_name: str) -> None:
         with self._lock:
@@ -200,6 +310,7 @@ class ServeController:
         if app:
             for d in app["deployments"].values():
                 self._stop_replicas(d, len(d.replicas))
+        self._checkpoint("delete")
 
     def list_applications(self) -> list[str]:
         with self._lock:
@@ -245,7 +356,7 @@ class ServeController:
 
     def status(self) -> dict:
         with self._lock:
-            return {
+            out: dict[str, Any] = {
                 app_name: {
                     name: {
                         "status": ds.status,
@@ -263,6 +374,18 @@ class ServeController:
                 }
                 for app_name, app in self._apps.items()
             }
+            # reserved key (consumers index by app name, so it can't
+            # collide): crash-recovery provenance for the load harness /
+            # operators — did this controller restart, from what
+            # checkpoint, and how long did recovery take
+            out["_controller"] = {
+                "restarts": self._restarts,
+                "recovered_at": self._recovered_at,
+                "recovery_seconds": self._recovery_s,
+                "checkpoint_version": CHECKPOINT_VERSION,
+                "checkpoint_seq": self._ckpt_seq,
+            }
+            return out
 
     def scale_deployment(
         self, app_name: str, deployment_name: str, target: int
@@ -282,6 +405,7 @@ class ServeController:
                 target = max(cfg.min_replicas, min(cfg.max_replicas, target))
             ds.target = target
             self._version += 1
+        self._checkpoint("target_change")
         return True
 
     def start_proxies(self, http_options: dict | None,
@@ -297,6 +421,7 @@ class ServeController:
             for nid in [n for n, ps in self._proxies.items()
                         if ps.state == "UNHEALTHY"]:
                 self._proxies.pop(nid)
+        self._checkpoint("proxy_cfg")
 
     def proxy_addresses(self) -> dict:
         """hex node_id -> {"http": (host, port), "grpc": (host, port)}
@@ -315,6 +440,15 @@ class ServeController:
 
     def shutdown(self) -> None:
         self._stopped.set()
+        # drop the checkpoint FIRST: an intentional teardown must not be
+        # resurrected by the next controller boot (crash recovery is for
+        # crashes; shutdown means "forget everything")
+        try:
+            kv_del(CHECKPOINT_KEY, ns=CHECKPOINT_NS)
+        except Exception as e:  # noqa: BLE001 — best-effort on teardown
+            logger.warning(
+                "serve controller checkpoint delete failed: %r", e
+            )
         with self._lock:
             apps = list(self._apps.values())
             self._apps.clear()
@@ -378,9 +512,17 @@ class ServeController:
             changed |= self._reconcile_deployment(app_name, name, ds)
         if proxy_cfg is not None:
             self._reconcile_proxies(proxy_cfg)
-        if changed:
-            with self._lock:
+        with self._lock:
+            if changed:
                 self._version += 1
+            dirty = self._ckpt_dirty
+        if changed:
+            # roster/status drift the explicit mutation sites don't cover
+            # (replica promoted/died, drain advanced) still checkpoints —
+            # recovery always sees the latest converged picture
+            self._checkpoint("reconcile")
+        elif dirty:
+            self._checkpoint("retry")
 
     # consecutive proxy-actor deaths before first HEALTHY that stop the
     # respawn loop for that node (mirrors the replica crash-loop guard)
@@ -595,6 +737,7 @@ class ServeController:
                 if shed != ds.shed:
                     with self._lock:
                         ds.shed = shed
+                    self._checkpoint("shed_flip")
                     changed = True
             else:
                 total = self._aggregate_inflight(app_name, name)
@@ -610,6 +753,7 @@ class ServeController:
                 )
                 with self._lock:
                     ds.target = new_target
+                self._checkpoint("target_change")
                 changed = True
             self._m_desired.set(
                 ds.target, tags={"app": app_name, "deployment": name}
@@ -789,8 +933,11 @@ class ServeController:
                 to_drain = running[:want]
             for r in to_kill:
                 ds.replicas.remove(r)
+            # drain deadlines ride obs.clock so the checkpoint can
+            # persist remaining-time and recovery can resume the countdown
+            # on the same clock (one-clock rule)
             deadline = (
-                time.monotonic() + ds.config.graceful_shutdown_timeout_s
+                obs.clock() + ds.config.graceful_shutdown_timeout_s
             )
             for r in to_drain:
                 r.state = "DRAINING"
@@ -802,6 +949,11 @@ class ServeController:
                 ray_tpu.kill(r.handle)
             except Exception:  # noqa: BLE001 — already dead is fine
                 pass
+        if to_drain:
+            # persist the drain BEFORE prepare_drain lands: a controller
+            # crash right after this point must recover replicas already
+            # latched non-admitting as DRAINING, not as routable RUNNING
+            self._checkpoint("drain_start")
         for r in to_drain:
             try:
                 # prepare_drain stops admissions replica-side and returns a
@@ -816,9 +968,11 @@ class ServeController:
         finish_drain once idle (release_all returns every KV block) ->
         kill + leave ds.replicas. A replica that dies mid-drain — or one
         still serving at the deadline — is killed as-is: its streams
-        resume byte-identically on survivors via the failover path."""
+        resume byte-identically on survivors via the failover path.
+        Deadlines ride obs.clock (checkpointed as remaining-time, so a
+        recovered controller resumes the countdown, not restarts it)."""
         changed = False
-        now = time.monotonic()
+        now = obs.clock()
         for r in [r for r in ds.replicas if r.state == "DRAINING"]:
             if r.finish_ref is not None:
                 # releasing: wait for finish_drain's release_all to land
@@ -870,6 +1024,7 @@ class ServeController:
             ray_tpu.kill(r.handle)
         except Exception:  # noqa: BLE001 — already dead is fine
             pass
+        self._checkpoint("drain_finish")
 
     def _start_replica(self, app_name: str, ds: _DeploymentState) -> None:
         spec = ds.spec
@@ -915,13 +1070,26 @@ class ServeController:
             max_concurrency,
         )
         rs = _ReplicaState(handle)
+        appended = False
         with self._lock:
             if ds.deleted:
                 # deleted while we were starting it — don't leak the actor
                 pass
             else:
                 ds.replicas.append(rs)
-                return
+                appended = True
+        if appended:
+            # the actor exists but no checkpoint knows it yet: a crash in
+            # this window leaks a replica unless recovery reaps it — the
+            # kill fire makes the window a deterministic chaos site for
+            # exactly that orphan-reconciliation proof
+            chaos.fire(
+                "controller.kill",
+                reason="replica_starting",
+                deployment=spec["name"],
+            )
+            self._checkpoint("replica_added")
+            return
         try:
             ray_tpu.kill(handle)
         except Exception:  # noqa: BLE001
@@ -936,3 +1104,305 @@ class ServeController:
                 ray_tpu.kill(r.handle)
             except Exception:  # noqa: BLE001 — already dead is fine
                 pass
+        if victims:
+            self._checkpoint("replica_stopped")
+
+    # ---------------- crash-recovery checkpointing ----------------
+
+    def _checkpoint(self, reason: str) -> None:
+        """Persist desired state + replica roster to the GCS internal KV.
+
+        Called after every state mutation (deploy/delete, target change,
+        shed flip, drain start/finish, replica add/retire, proxy config).
+        The write is one atomic kv_put of a small JSON blob — there is no
+        half-written state to recover from. A failed write degrades to
+        warn-and-retry (_ckpt_dirty; the reconcile loop retries every
+        pass), never an inconsistent controller. The ``controller.kill``
+        fire after a SUCCESSFUL write is the chaos anchor crash-recovery
+        tests kill at, so the checkpoint provably contains the mutation
+        the test expects recovery to honor."""
+        if self._stopped.is_set():
+            return  # tearing down: shutdown() already deleted the key
+        with self._ckpt_io_lock:
+            with self._lock:
+                self._ckpt_dirty = False
+                self._ckpt_seq += 1
+                payload = self._build_checkpoint_locked()
+            try:
+                chaos.fire(
+                    "controller.checkpoint", reason=reason,
+                    seq=payload["seq"],
+                )
+                kv_put(
+                    CHECKPOINT_KEY, encode_checkpoint(payload),
+                    ns=CHECKPOINT_NS,
+                )
+            except Exception as e:  # noqa: BLE001 — degrade, never crash
+                with self._lock:
+                    self._ckpt_dirty = True
+                logger.warning(
+                    "serve controller checkpoint write failed (%s), "
+                    "will retry: %r", reason, e,
+                )
+                return
+        chaos.fire("controller.kill", reason=reason)
+
+    def _build_checkpoint_locked(self) -> dict:
+        """Snapshot desired state + roster (caller holds self._lock)."""
+        now = obs.clock()
+        apps: dict[str, Any] = {}
+        for app_name, app in self._apps.items():
+            deps = {}
+            for name, ds in app["deployments"].items():
+                deps[name] = {
+                    "spec_blob": encode_spec(ds.spec),
+                    "target": ds.target,
+                    "status": ds.status,
+                    # shed is persisted for inspection only; recovery
+                    # recomputes it from fresh snapshots (see _recover)
+                    "shed": ds.shed,
+                    "signal_capable": ds.signal_capable,
+                    "drain_capable": ds.drain_capable,
+                    "batch_configs": ds.batch_configs,
+                    "stream_methods": list(ds.stream_methods),
+                    "replicas": [
+                        {
+                            "actor_id": r.actor_id.hex(),
+                            "state": r.state,
+                            # remaining time, not an absolute deadline:
+                            # obs.clock doesn't survive the process
+                            "drain_remaining_s": (
+                                max(0.0, r.drain_deadline - now)
+                                if r.state == "DRAINING"
+                                else None
+                            ),
+                        }
+                        for r in ds.replicas
+                        if r.state != "STOPPING"
+                    ],
+                }
+            apps[app_name] = {
+                "ingress": app["ingress"],
+                "route_prefix": app["route_prefix"],
+                "deployments": deps,
+            }
+        return {
+            "version": CHECKPOINT_VERSION,
+            "seq": self._ckpt_seq,
+            "written_at": obs.wall(),
+            "restarts": self._restarts,
+            "reconciler_version": self._version,
+            "apps": apps,
+            "proxy_cfg": (
+                list(self._proxy_cfg) if self._proxy_cfg else None
+            ),
+        }
+
+    def _recover(self) -> None:
+        """Rebuild state from the last checkpoint after a crash restart.
+
+        Steps: load + validate the checkpoint (unknown versions are
+        rejected loudly — boot fresh rather than guess); re-resolve each
+        checkpointed replica actor against the GCS, adopting live ones
+        (RUNNING replicas re-enter the ping path immediately, DRAINING
+        ones resume their drain with the checkpointed remaining time and
+        an idempotent re-latch of prepare_drain); reap orphan replica
+        actors the checkpoint doesn't know — they were created in the
+        window between a mutation and its checkpoint, or belong to an
+        app deleted mid-outage; re-adopt per-node proxies by name. Shed
+        flags are NOT restored: fresh autoscaling snapshots recompute
+        them within a reconcile pass, so a stale flag from before the
+        crash can't fail-close a now-healthy fleet. Idempotent — running
+        it twice converges to the same state."""
+        chaos.fire("controller.recover")
+        t0 = obs.clock()
+        try:
+            blob = kv_get(CHECKPOINT_KEY, ns=CHECKPOINT_NS)
+        except Exception as e:  # noqa: BLE001 — GCS unreachable
+            logger.error(
+                "controller recovery: checkpoint read failed: %r", e
+            )
+            return
+        if blob is None:
+            return  # fresh boot: nothing to recover, nothing to reap
+        try:
+            ckpt = decode_checkpoint(bytes(blob))
+        except Exception as e:  # noqa: BLE001 — unknown version/corrupt:
+            logger.error(        # refuse to guess; boot fresh and loud
+                "controller recovery: checkpoint rejected: %r", e
+            )
+            return
+        known: set[bytes] = set()
+        apps: dict[str, dict] = {}
+        adopted = 0
+        for app_name, app in ckpt["apps"].items():
+            deps: dict[str, _DeploymentState] = {}
+            for name, d in app["deployments"].items():
+                try:
+                    ds = _DeploymentState(decode_spec(d["spec_blob"]))
+                except Exception as e:  # noqa: BLE001 — one bad spec
+                    logger.error(       # must not sink the whole recovery
+                        "controller recovery: spec for %s/%s unreadable, "
+                        "dropping the deployment: %r", app_name, name, e,
+                    )
+                    continue
+                ds.target = int(d["target"])
+                ds.signal_capable = bool(d.get("signal_capable"))
+                ds.drain_capable = bool(d.get("drain_capable"))
+                ds.batch_configs = d.get("batch_configs") or {}
+                ds.stream_methods = list(d.get("stream_methods") or ())
+                for rep in d.get("replicas", ()):
+                    aid = bytes.fromhex(rep["actor_id"])
+                    known.add(aid)
+                    r = self._adopt_replica(aid, rep, ds.config)
+                    if r is not None:
+                        ds.replicas.append(r)
+                        adopted += 1
+                deps[name] = ds
+            apps[app_name] = {
+                "deployments": deps,
+                "ingress": app["ingress"],
+                "route_prefix": app.get("route_prefix"),
+            }
+        reaped = self._reap_orphans(known)
+        proxies = self._readopt_proxies(ckpt.get("proxy_cfg"))
+        with self._lock:
+            self._apps = apps
+            self._proxies = proxies
+            pc = ckpt.get("proxy_cfg")
+            if pc is not None:
+                self._proxy_cfg = (pc[0], pc[1])
+            self._ckpt_seq = int(ckpt["seq"])
+            self._restarts = int(ckpt.get("restarts", 0)) + 1
+            # keep routing-table versions advancing across restarts so
+            # proxy route-sync loops never skip the post-recovery update
+            self._version = int(ckpt.get("reconciler_version", 0)) + 1
+        self._m_restarts.inc()
+        self._recovery_s = obs.clock() - t0
+        self._m_recovery.observe(self._recovery_s)
+        self._recovered_at = obs.wall()
+        logger.warning(
+            "serve controller recovered from checkpoint seq=%s: %d app(s), "
+            "%d replica(s) adopted, %d orphan(s) reaped, in %.3fs",
+            ckpt["seq"], len(apps), adopted, reaped, self._recovery_s,
+        )
+        self._checkpoint("recovered")
+
+    def _adopt_replica(
+        self, aid: bytes, rep: dict, cfg: DeploymentConfig
+    ) -> _ReplicaState | None:
+        """Re-resolve one checkpointed replica actor; None when it died
+        during the outage (the convergence step replaces it)."""
+        worker = ray_tpu.worker.global_worker()
+        try:
+            info = worker.gcs.call("get_actor", {"actor_id": aid})["actor"]
+        except Exception as e:  # noqa: BLE001 — GCS hiccup: treat as dead
+            logger.warning(
+                "controller recovery: get_actor(%s) failed: %r",
+                aid.hex(), e,
+            )
+            return None
+        if info is None or info.get("state") == "DEAD":
+            return None
+        r = _ReplicaState(
+            ActorHandle(ActorID(aid), info.get("class_name", "ReplicaActor"))
+        )
+        state = rep.get("state", "STARTING")
+        if state == "RUNNING":
+            # adopt via the existing ping path: next_ping_at=0 makes the
+            # first health-check pass validate it NOW; a replica wedged
+            # during the outage is killed and replaced like any other
+            r.state = "RUNNING"
+            r.next_ping_at = 0.0
+        elif state == "DRAINING":
+            r.state = "DRAINING"
+            remaining = rep.get("drain_remaining_s")
+            if remaining is None:
+                remaining = cfg.graceful_shutdown_timeout_s
+            r.drain_deadline = obs.clock() + float(remaining)
+            try:
+                # idempotent re-latch: the pre-crash prepare_drain may or
+                # may not have landed; this also doubles as the first
+                # drain_status poll for _advance_drains
+                r.drain_ref = r.handle.rt_call.remote(
+                    "prepare_drain", (), {}
+                )
+            except Exception as e:  # noqa: BLE001 — died just now; the
+                logger.warning(     # reconcile pass reaps it
+                    "controller recovery: prepare_drain re-latch failed "
+                    "for %s: %r", aid.hex(), e,
+                )
+        # STARTING replicas stay STARTING: the readiness probe re-runs
+        return r
+
+    def _reap_orphans(self, known: set[bytes]) -> int:
+        """Kill live ReplicaActors the checkpoint doesn't know. Only ever
+        called with a checkpoint in hand — a fresh boot must not reap
+        (it has no roster to judge against)."""
+        worker = ray_tpu.worker.global_worker()
+        try:
+            actors = worker.gcs.call("list_actors")["actors"]
+        except Exception as e:  # noqa: BLE001 — skip the sweep this boot
+            logger.warning(
+                "controller recovery: list_actors failed, orphan sweep "
+                "skipped: %r", e,
+            )
+            return 0
+        reaped = 0
+        for a in actors:
+            if a.get("class_name") != "ReplicaActor":
+                continue
+            if a.get("state") == "DEAD" or a["actor_id"] in known:
+                continue
+            try:
+                ray_tpu.kill(
+                    ActorHandle(ActorID(a["actor_id"]), "ReplicaActor")
+                )
+                reaped += 1
+            except Exception as e:  # noqa: BLE001 — died on its own
+                logger.warning(
+                    "controller recovery: orphan %s kill failed: %r",
+                    a["actor_id"].hex(), e,
+                )
+        if reaped:
+            self._m_orphans.inc(reaped)
+            logger.warning(
+                "controller recovery: reaped %d orphan replica(s) the "
+                "checkpoint did not know", reaped,
+            )
+        return reaped
+
+    def _readopt_proxies(
+        self, proxy_cfg
+    ) -> dict[bytes, "_ProxyState"]:
+        """Re-adopt per-node proxy actors by their well-known names.
+        Adopted proxies re-enter the ping path as STARTING, which
+        re-learns their bound addresses without a restart."""
+        proxies: dict[bytes, _ProxyState] = {}
+        if proxy_cfg is None:
+            return proxies
+        worker = ray_tpu.worker.global_worker()
+        try:
+            nodes = worker.gcs.call("get_nodes")["nodes"]
+        except Exception as e:  # noqa: BLE001 — reconcile restarts them
+            logger.warning(
+                "controller recovery: get_nodes failed, proxies will be "
+                "restarted by reconcile: %r", e,
+            )
+            return proxies
+        for n in nodes:
+            if not n.get("alive"):
+                continue
+            nid = n["node_id"]
+            try:
+                handle = ray_tpu.get_actor(
+                    f"RT_SERVE_PROXY:{nid.hex()[:12]}"
+                )
+            except ValueError:
+                logger.info(
+                    "controller recovery: no proxy on node %s yet",
+                    nid.hex()[:12],
+                )
+                continue  # reconcile starts one
+            proxies[nid] = _ProxyState(handle)
+        return proxies

@@ -10,6 +10,8 @@ any gRPC client can call it:
     rpc Call   (bytes) returns (bytes)          — unary request/response
     rpc Stream (bytes) returns (stream bytes)   — server streaming (LLM
                                                   token decode)
+    rpc Healthz (bytes) returns (bytes)         — controller-independent
+                                                  readiness probe
 
 Request bytes are a JSON payload (or raw bytes if not JSON). Routing
 metadata keys (matching the reference's proxy metadata contract):
@@ -21,6 +23,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import threading
 import time
 import uuid
@@ -35,9 +38,12 @@ from ray_tpu.exceptions import (
 from ray_tpu.serve.proxy import TRACE_HEADER, TRACE_ID_HEADER, log_access
 from ray_tpu.util import tracing
 
+logger = logging.getLogger("ray_tpu.serve.grpc")
+
 SERVICE_NAME = "ray_tpu.serve.ServeAPI"
 CALL_METHOD = f"/{SERVICE_NAME}/Call"
 STREAM_METHOD = f"/{SERVICE_NAME}/Stream"
+HEALTHZ_METHOD = f"/{SERVICE_NAME}/Healthz"
 
 _APP_CACHE_TTL_S = 2.0
 
@@ -106,8 +112,19 @@ class GrpcProxy:
         import ray_tpu
         from ray_tpu.serve.controller import CONTROLLER_NAME
 
-        controller = ray_tpu.get_actor(CONTROLLER_NAME)
-        table = ray_tpu.get(controller.get_routing_table.remote(), timeout=30)
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            table = ray_tpu.get(
+                controller.get_routing_table.remote(), timeout=5
+            )
+        except Exception as e:  # noqa: BLE001 — controller outage: keep
+            if hit is not None:  # serving the expired-but-known mapping
+                logger.warning(
+                    "gRPC ingress lookup for %r failed (controller "
+                    "down?); serving cached mapping: %r", app_name, e,
+                )
+                return hit[0]
+            raise
         app = table["apps"].get(app_name)
         if app is None:
             raise KeyError(f"no serve application named {app_name!r}")
@@ -283,6 +300,13 @@ class GrpcProxy:
                        status=code.name, error=str(e))
             context.abort(code, str(e))
 
+    def _healthz(self, request: bytes, context) -> bytes:
+        """Controller-independent readiness probe (mirrors the HTTP
+        proxy's /healthz): answers from purely local state so load
+        balancers keep this proxy in rotation through a controller
+        outage — requests still route from cached tables."""
+        return b'{"status":"ok"}'
+
     # -- server lifecycle --
 
     def start(self) -> None:
@@ -298,6 +322,10 @@ class GrpcProxy:
             ),
             "Stream": grpc.unary_stream_rpc_method_handler(
                 self._stream, request_deserializer=identity,
+                response_serializer=identity,
+            ),
+            "Healthz": grpc.unary_unary_rpc_method_handler(
+                self._healthz, request_deserializer=identity,
                 response_serializer=identity,
             ),
         }

@@ -11,6 +11,7 @@ callers from different processes share one padded batch.
 """
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
@@ -24,7 +25,19 @@ from ray_tpu._private import chaos
 from ray_tpu.exceptions import EngineOverloadedError
 from ray_tpu.util import metrics, tracing
 
+logger = logging.getLogger("ray_tpu.serve.handle")
+
 _TABLE_REFRESH_S = 0.25
+# controller-outage survival: a failed routing-table RPC keeps serving
+# the cached table (the controller only ever removes entries the
+# failover path already tolerates), bounded by a short per-RPC timeout
+# so one refresh can't stall a dispatch for the whole outage
+_TABLE_RPC_TIMEOUT_S = 5.0
+# the shed flag is only as fresh as the table that carried it: once the
+# table is older than this, fail OPEN (engines still shed engine-side)
+# instead of rejecting all traffic on a flag the dead controller can no
+# longer retract
+_SHED_MAX_AGE_S = 3.0
 # how long a mid-stream failover RESUME keeps retrying through transient
 # EngineOverloadedError (draining-replica race, momentary saturation)
 # before failing the half-delivered stream
@@ -289,6 +302,14 @@ class _Router:
         self._outstanding: dict[bytes, bytes] = {}  # object_id -> actor_id
         self._last_refresh = 0.0
         self._controller = None
+        # monotonic stamp of the last SUCCESSFUL table fetch (shed aging)
+        self._table_at = 0.0
+        # controller re-resolution backoff (seeded like the resume path:
+        # every router in the process must not hammer the GCS in lockstep
+        # when a restarted controller comes back)
+        self._ctrl_attempt = 0
+        self._next_ctrl_retry = 0.0
+        self._ctrl_backoff_seed = zlib.crc32(self.router_id.encode())
         # cluster-wide admission: the controller marks the deployment shed
         # when the whole fleet is saturated (fleet_saturated); data-plane
         # dispatches then fail fast with EngineOverloadedError instead of
@@ -309,22 +330,52 @@ class _Router:
             self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
         return self._controller
 
+    def _invalidate_controller(self) -> None:
+        """Forget the cached controller handle after a failed RPC. A
+        controller restarted in place (the raylet supervisor path) keeps
+        its actor id, but a recreated one does not — re-resolving by
+        name on the next attempt covers both, and the seeded backoff
+        spreads the re-resolve attempts of every router in the process."""
+        with self._lock:
+            self._controller = None
+            self._next_ctrl_retry = time.monotonic() + resume_backoff_s(
+                self._ctrl_backoff_seed, self._ctrl_attempt
+            )
+            self._ctrl_attempt += 1
+
     def _refresh(self, force: bool = False) -> None:
+        """Refresh the routing table. During a controller outage this
+        DEGRADES instead of failing: the cached table keeps serving and
+        the controller handle is re-resolved under backoff once the
+        supervisor restarts it. 'app/deployment not found' is only
+        raised on a SUCCESSFUL fetch that proves the absence."""
         now = time.monotonic()
         with self._lock:
             if not force and now - self._last_refresh < _TABLE_REFRESH_S:
                 return
+            if self._controller is None and now < self._next_ctrl_retry:
+                return  # outage backoff: keep serving the cached table
             self._last_refresh = now
             load_report = {
                 (self.app_name, self.deployment_name): sum(self._inflight.values())
             }
         self._sweep()
-        table = ray_tpu.get(
-            self._controller_handle().get_routing_table.remote(
-                self.router_id, {tuple(k): v for k, v in load_report.items()}
-            ),
-            timeout=30,
-        )
+        try:
+            table = ray_tpu.get(
+                self._controller_handle().get_routing_table.remote(
+                    self.router_id,
+                    {tuple(k): v for k, v in load_report.items()},
+                ),
+                timeout=_TABLE_RPC_TIMEOUT_S,
+            )
+        except Exception as e:  # noqa: BLE001 — controller outage
+            self._invalidate_controller()
+            logger.warning(
+                "routing-table refresh for %s/%s failed (controller "
+                "down?); serving cached table: %r",
+                self.app_name, self.deployment_name, e,
+            )
+            return
         app = table["apps"].get(self.app_name)
         if app is None:
             raise RuntimeError(f"serve application {self.app_name!r} not found")
@@ -340,6 +391,9 @@ class _Router:
             self._stream_methods = set(dep.get("stream_methods", ()))
             self._max_ongoing = dep["max_ongoing_requests"]
             self._shed = bool(dep.get("shed", False))
+            self._table_at = time.monotonic()
+            self._ctrl_attempt = 0
+            self._next_ctrl_retry = 0.0
 
     # -- in-flight accounting --
 
@@ -413,6 +467,12 @@ class _Router:
         with self._lock:
             is_stream = method_name in self._stream_methods
             shed = self._shed
+            if shed and time.monotonic() - self._table_at > _SHED_MAX_AGE_S:
+                # stale flag during a controller outage: age it out and
+                # fail open — the saturated engines still shed for
+                # themselves, but an unreachable controller must not keep
+                # rejecting traffic it can no longer observe
+                shed = self._shed = False
         if shed and not exclude and (is_stream or method_name == "__call__"):
             # fleet-wide saturation: reject NEW data-plane work before it
             # queues (control methods — cancel, stats, debug — still pass;

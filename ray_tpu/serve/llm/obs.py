@@ -166,13 +166,54 @@ def dump_dir(explicit: str | None = None) -> str:
     )
 
 
+# dump-directory bound: keep the newest N auto-named dumps. Repeated
+# engine deaths (e.g. a crash-looping deployment respawning through a
+# controller outage) write one dump per death — unbounded, that fills
+# the disk the incident responder needs for the postmortem itself.
+FLIGHT_KEEP_ENV = "RAY_TPU_FLIGHT_KEEP"
+_FLIGHT_KEEP_DEFAULT = 20
+
+
+def _prune_dumps(d: str) -> None:
+    """Rotate auto-named flight dumps in ``d``: keep the newest N
+    (RAY_TPU_FLIGHT_KEEP, default 20; <= 0 disables rotation).
+    Best-effort like the writes — pruning must never raise."""
+    try:
+        keep = int(os.environ.get(FLIGHT_KEEP_ENV, _FLIGHT_KEEP_DEFAULT))
+    except ValueError:
+        keep = _FLIGHT_KEEP_DEFAULT
+    if keep <= 0:
+        return
+    try:
+        names = [
+            n
+            for n in os.listdir(d)
+            if n.startswith("llm_flight_") and n.endswith(".json")
+        ]
+        if len(names) <= keep:
+            return
+        # auto-generated names embed wall-clock ms, but concurrent pids
+        # interleave — mtime is the honest recency order
+        paths = sorted(
+            (os.path.join(d, n) for n in names),
+            key=lambda p: os.stat(p).st_mtime,
+        )
+        for p in paths[:-keep]:
+            os.unlink(p)
+    except OSError as e:
+        logger.warning("flight-recorder dir prune failed: %r", e)
+
+
 def write_dump(
     dump: dict, *, dir: str | None = None, path: str | None = None
 ) -> str | None:
     """Serialize one flight-recorder dump to disk. Best-effort by
     contract: the dump happens while the engine is dying, and
     observability must never turn a clean failure fan-out into a crash —
-    returns the path, or None when the write failed."""
+    returns the path, or None when the write failed. Auto-named dumps
+    rotate (newest RAY_TPU_FLIGHT_KEEP kept); an explicit ``path`` is
+    the caller's to manage."""
+    auto = path is None
     try:
         if path is None:
             d = dump_dir(dir)
@@ -183,6 +224,8 @@ def write_dump(
             )
         with open(path, "w") as f:
             json.dump(dump, f, indent=1, default=str)
+        if auto:
+            _prune_dumps(os.path.dirname(path))
         return path
     except Exception as e:  # noqa: BLE001 — never fail the failure path
         logger.warning("flight-recorder dump failed: %r", e)

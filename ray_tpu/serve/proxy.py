@@ -275,6 +275,16 @@ class HTTPProxy:
             )
 
         async def handler(request: web.Request) -> web.Response:
+            if request.path == "/healthz":
+                # controller-INDEPENDENT readiness: answers from purely
+                # local state, so load balancers keep this proxy in
+                # rotation through a controller outage (routing keeps
+                # working from cached tables; see handle._Router._refresh)
+                with self._routes_lock:
+                    n_routes = len(self._routes)
+                return web.json_response(
+                    {"status": "ok", "routes": n_routes}
+                )
             if request.path == "/debug/llm":
                 return await debug_llm(request)
             target = self._match(request.path)

@@ -218,7 +218,11 @@ def test_handoff_retry_paths_never_swallow_silently():
     and the mid-stream RESUME loop (handle.py — outside serve/llm, so
     the serving-path bare-except lint doesn't reach it) must contain a
     ``raise`` or a logging/metrics call; handle.py additionally must
-    have no bare excepts anywhere."""
+    have no bare excepts anywhere. The controller's crash-recovery and
+    checkpoint paths (ISSUE 12) are held to the same bar: every typed
+    fallback there (checkpoint write failed -> retry, replica dead ->
+    drop, orphan kill raced) changes cluster state, so a handler that
+    neither raises nor logs turns a recovery decision invisible."""
     import ast
     import pathlib
 
@@ -233,7 +237,11 @@ def test_handoff_retry_paths_never_swallow_silently():
             "_seal_handoff", "_sweep_attempts",
         }),
         root / "ray_tpu" / "serve" / "handle.py": frozenset({
-            "__next__", "resume_backoff_s",
+            "__next__", "resume_backoff_s", "_refresh",
+        }),
+        root / "ray_tpu" / "serve" / "controller.py": frozenset({
+            "_recover", "_checkpoint", "_adopt_replica",
+            "_reap_orphans", "_readopt_proxies",
         }),
     }
     offenders = []
@@ -324,7 +332,13 @@ def test_one_clock_in_autoscaling_control_plane():
     different timebase, so snapshot TTLs (and therefore up/down decisions)
     drift. Scope: all of serve/autoscaling_policy.py, plus the
     controller's snapshot-aggregation functions — lifecycle deadline math
-    elsewhere in the controller legitimately uses time.monotonic."""
+    elsewhere in the controller legitimately uses time.monotonic.
+
+    The crash-recovery paths (ISSUE 12) are pinned the same way: the
+    checkpoint persists drain deadlines as remaining-time measured on
+    obs.clock and stamps written_at/recovered_at with obs.wall, so a
+    stray raw clock in _checkpoint/_recover would resume a drain
+    against a timebase the checkpoint was never measured on."""
     import ast
     import pathlib
 
@@ -332,6 +346,9 @@ def test_one_clock_in_autoscaling_control_plane():
     banned = {"time", "monotonic", "perf_counter"}
     aggregation_fns = frozenset(
         {"_aggregate_inflight", "_aggregate_signals", "_poll_snapshots"})
+    recovery_fns = frozenset(
+        {"_recover", "_checkpoint", "_build_checkpoint_locked",
+         "_adopt_replica"})
 
     def raw_clock_calls(path, within=None):
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -368,10 +385,11 @@ def test_one_clock_in_autoscaling_control_plane():
     controller = root / "ray_tpu" / "serve" / "controller.py"
     # the scoped functions must exist — a rename would silently un-lint them
     ctrl_src = controller.read_text()
-    for fn in aggregation_fns:
+    for fn in aggregation_fns | recovery_fns:
         assert f"def {fn}(" in ctrl_src, f"controller lost {fn}()"
     offenders = raw_clock_calls(policy)
-    offenders += raw_clock_calls(controller, within=aggregation_fns)
+    offenders += raw_clock_calls(
+        controller, within=aggregation_fns | recovery_fns)
     assert not offenders, (
         f"raw clock reads in the autoscaling control plane: {offenders}"
     )

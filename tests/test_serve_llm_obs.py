@@ -203,6 +203,33 @@ def test_flight_recorder_ring_is_bounded(jax_cpu):
     eng.shutdown()
 
 
+@pytest.mark.timeout(60)
+def test_flight_dump_dir_is_bounded(tmp_path, monkeypatch):
+    """Auto-named dumps rotate: only the newest RAY_TPU_FLIGHT_KEEP
+    survive repeated engine deaths (a crash-looping deployment must not
+    fill the disk the postmortem needs). keep <= 0 disables rotation."""
+    from ray_tpu.serve.llm import obs
+
+    monkeypatch.setenv(obs.FLIGHT_KEEP_ENV, "3")
+    paths = []
+    for i in range(6):
+        p = obs.write_dump({"reason": f"death-{i}"}, dir=str(tmp_path))
+        assert p is not None
+        paths.append(p)
+        time.sleep(0.002)  # distinct auto-names + strict mtime order
+    survivors = sorted(glob.glob(str(tmp_path / "llm_flight_*.json")))
+    assert survivors == sorted(paths[-3:]), "must keep exactly the newest 3"
+    # the survivors are whole, readable dumps
+    assert json.loads(open(survivors[0]).read())["reason"] == "death-3"
+
+    monkeypatch.setenv(obs.FLIGHT_KEEP_ENV, "0")
+    for i in range(5):
+        obs.write_dump({"reason": f"nocap-{i}"}, dir=str(tmp_path))
+        time.sleep(0.002)
+    assert len(glob.glob(str(tmp_path / "llm_flight_*.json"))) == 8, \
+        "keep=0 must disable rotation entirely"
+
+
 @pytest.mark.chaos
 @pytest.mark.timeout(180)
 def test_engine_death_writes_flight_dump(jax_cpu, chaos_plan, tmp_path):
