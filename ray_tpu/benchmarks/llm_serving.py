@@ -299,6 +299,14 @@ def run_serving_bench() -> dict:
         "prefill_tokens_computed": st["prefill_tokens_total"],
         "cow_blocks": st["cow_blocks"],
         "prefix_evicted_blocks": st["prefix_evicted_blocks"],
+        # windowed goodput/MFU per step kind (engine._goodput_record_locked
+        # — nonzero whenever that kind stepped inside the window)
+        "llm_goodput_tokens_per_sec": {
+            k: v["tokens_per_sec"] for k, v in st["goodput"].items()
+        },
+        "llm_serving_mfu": {
+            k: v["mfu"] for k, v in st["goodput"].items()
+        },
     }
 
 
@@ -561,6 +569,58 @@ def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
     return requests
 
 
+def _fleet_rollup_samples(families: dict, family: str):
+    """The FleetAggregator ROLLUP samples of one family — the ones
+    WITHOUT a ``replica_id`` label (per-replica series carry it; the
+    rollup drops it and merges per kind)."""
+    fam = families.get(family)
+    if not fam:
+        return
+    for s in fam["samples"]:
+        if "replica_id" not in s["labels"]:
+            yield s
+
+
+def _fleet_counter_total(families: dict, family: str) -> float:
+    """Summed rollup value of one fleet counter family."""
+    return sum(
+        s["value"]
+        for s in _fleet_rollup_samples(families, family)
+        if s["name"] == f"{family}_total"
+    )
+
+
+def _fleet_hist_p99_ms(families: dict, family: str):
+    """(p99 in ms, total count) of one fleet histogram family from its
+    rollup buckets. Prometheus ``le`` buckets are cumulative and the
+    aggregator's bucket-wise sum keeps them cumulative, so the p99 is
+    the smallest bound whose cumulative count crosses 0.99*count — the
+    same upper-bound estimate promql's histogram_quantile makes."""
+    buckets: dict[float, float] = {}
+    total = 0.0
+    for s in _fleet_rollup_samples(families, family):
+        if s["name"] == f"{family}_bucket":
+            le = float(s["labels"].get("le", "inf"))
+            buckets[le] = buckets.get(le, 0.0) + s["value"]
+        elif s["name"] == f"{family}_count":
+            total += s["value"]
+    if total <= 0 or not buckets:
+        return None, int(total)
+    target = 0.99 * total
+    p99 = None
+    for le in sorted(buckets):
+        if buckets[le] >= target:
+            p99 = le
+            break
+    if p99 is None or p99 == float("inf"):
+        finite = [le for le in buckets if le != float("inf")]
+        p99 = max(finite) if finite else None
+    return (
+        round(p99 * 1e3, 3) if p99 is not None else None,
+        int(total),
+    )
+
+
 def run_load_bench(prefill_replicas: int = 0) -> dict:
     """Multi-replica chaos load harness: open-loop seeded bursty traffic
     through a kill + graceful drain + signal-driven autoscale event.
@@ -752,6 +812,15 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
         dr.join(timeout=60)
         stop.set()
         sam.join(timeout=10)
+        # -- fleet metrics pull, before teardown kills the controller:
+        # every stream is done; waiting out a few poll periods lets the
+        # replicas' final metrics_report snapshots land in the aggregator
+        time.sleep(1.5)
+        fleet = None
+        try:
+            fleet = ray_tpu.get(ctrl.fleet_metrics.remote(), timeout=30)
+        except Exception:  # noqa: BLE001 — crosscheck degrades below
+            pass
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
@@ -796,6 +865,58 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
 
     targets = [s["target_replicas"] for s in status_samples]
     scale_events = sum(1 for a, b in zip(targets, targets[1:]) if a != b)
+
+    # -- fleet-vs-timeline crosscheck: the aggregation path is judged
+    # against the client-side numbers this harness already computes --
+    from ray_tpu.util import metrics as _metrics
+
+    fleet_keys: dict = {
+        "llm_fleet_ttft_p99_ms": None,
+        "llm_fleet_tpot_p99_ms": None,
+        "llm_fleet_shed_rate": None,
+        "llm_fleet_sources": 0,
+        "llm_fleet_crosscheck_ok": False,
+    }
+    if fleet is not None:
+        fams = fleet["families"]
+        ttft_p99, ttft_n = _fleet_hist_p99_ms(fams, "llm_ttft_seconds")
+        tpot_p99, tpot_n = _fleet_hist_p99_ms(
+            fams, "llm_time_per_output_token_seconds")
+        # the router-side shed counter lives in THIS process (the
+        # controller cannot poll the driver), so the driver's registry
+        # joins the merge as the "client" source; engine-side admission
+        # rejections are fleet-polled. Their union is what a client
+        # experiences as EngineOverloadedError.
+        client_families = _metrics.collect_families()
+        client_shed = sum(
+            s["value"]
+            for fam in (client_families.get("llm_requests_shed"),)
+            if fam
+            for s in fam["samples"]
+            if s["name"] == "llm_requests_shed_total"
+        )
+        merged_shed = client_shed + _fleet_counter_total(
+            fams, "llm_requests_rejected")
+        # Invariants, both >=-shaped because the fleet side can only see
+        # MORE: failover re-runs re-observe TTFT on the survivor, and
+        # the tagged chaos request's shed-window retries re-count shed.
+        # (TPOT is checked for presence, not count — the last poll of a
+        # drained replica can trail its final token gaps by one period.)
+        ok = (
+            ttft_n >= len(ttfts)
+            and merged_shed >= shed
+            and (ttft_p99 is not None or ttft_n == 0)
+            and (tpot_p99 is not None or not tpots)
+        )
+        fleet_keys.update({
+            "llm_fleet_ttft_p99_ms": ttft_p99,
+            "llm_fleet_tpot_p99_ms": tpot_p99,
+            "llm_fleet_ttft_count": ttft_n,
+            "llm_fleet_tpot_count": tpot_n,
+            "llm_fleet_shed_rate": round(merged_shed / max(total, 1), 4),
+            "llm_fleet_sources": len(fleet.get("sources", {})),
+            "llm_fleet_crosscheck_ok": bool(ok),
+        })
     return {
         "llm_load_requests": total,
         "llm_load_completed": len(accepted),
@@ -820,6 +941,7 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
             (s["running_replicas"] for s in status_samples), default=None),
         "llm_load_drain_observed": any(
             s["draining_replicas"] > 0 for s in status_samples),
+        **fleet_keys,
     }
 
 

@@ -52,6 +52,7 @@ class Dashboard:
     def _serve(self) -> None:
         from aiohttp import web
 
+        import ray_tpu
         from ray_tpu.util import state
 
         def offload(fn, *args):
@@ -108,6 +109,65 @@ class Dashboard:
                 return web.json_response({"error": "no such job"}, status=404)
             return web.json_response({"stopped": stopped})
 
+        def _controller():
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+
+            return ray_tpu.get_actor(CONTROLLER_NAME)
+
+        def _fleet_metrics():
+            ctrl = _controller()
+            return ray_tpu.get(ctrl.fleet_metrics.remote(), timeout=30)
+
+        def _fleet_history(series, prefix):
+            ctrl = _controller()
+            return ray_tpu.get(
+                ctrl.fleet_history.remote(series, prefix), timeout=30
+            )
+
+        async def fleet_metrics_text(request):
+            """THE fleet scrape target: Prometheus text exposition of
+            every replica/proxy/controller series, relabeled and rolled
+            up by the controller's FleetAggregator."""
+            try:
+                out = await offload(_fleet_metrics)
+            except Exception as e:  # noqa: BLE001 — no controller yet
+                return web.json_response(
+                    {"error": f"serve controller unavailable: {e}"},
+                    status=503,
+                )
+            return web.Response(
+                text=out["text"],
+                content_type="text/plain",
+                charset="utf-8",
+            )
+
+        async def fleet_metrics_json(request):
+            try:
+                out = await offload(_fleet_metrics)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"serve controller unavailable: {e}"},
+                    status=503,
+                )
+            return web.json_response(
+                {"families": out["families"], "sources": out["sources"]}
+            )
+
+        async def fleet_history(request):
+            """Ring-buffer time series behind the scrape target:
+            ``?series=<exact key>`` or ``?prefix=<name prefix>`` —
+            queryable after the source replica is gone."""
+            series = request.query.get("series")
+            prefix = request.query.get("prefix")
+            try:
+                hist = await offload(_fleet_history, series, prefix)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"serve controller unavailable: {e}"},
+                    status=503,
+                )
+            return web.json_response({"series": hist})
+
         loop = asyncio.new_event_loop()
         self._loop = loop
         asyncio.set_event_loop(loop)
@@ -121,6 +181,9 @@ class Dashboard:
         app.router.add_get("/api/jobs/{job_id}", job_status)
         app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
         app.router.add_post("/api/jobs/{job_id}/stop", stop_job)
+        app.router.add_get("/metrics/fleet", fleet_metrics_text)
+        app.router.add_get("/api/metrics/fleet", fleet_metrics_json)
+        app.router.add_get("/api/metrics/fleet/history", fleet_history)
         runner = web.AppRunner(app)
         try:
             loop.run_until_complete(runner.setup())

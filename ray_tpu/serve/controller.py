@@ -54,6 +54,12 @@ _METRIC_TTL_S = 5.0
 # deployments only) and the patience per pull
 _SNAPSHOT_PERIOD_S = 0.5
 _SNAPSHOT_TIMEOUT_S = 30.0
+# fleet metrics plane: cadence of metrics_report pulls (EVERY replica
+# and proxy, not capability-gated), patience per pull, and ring depth
+# per fleet series (~3 minutes of history at the poll cadence)
+_FLEET_PERIOD_S = 0.5
+_FLEET_TIMEOUT_S = 30.0
+_FLEET_HISTORY_SAMPLES = 360
 # extra actor method threads beyond max_ongoing_requests, so control-plane
 # calls (ping / autoscaling_snapshot / drain_status) never park behind a
 # data plane running at full concurrency — a saturated replica must still
@@ -126,6 +132,10 @@ class _ReplicaState:
         self.snapshot_ref = None
         self.snapshot_deadline = 0.0
         self.next_snapshot_at = 0.0
+        # fleet metrics_report polling (same obs.clock ref discipline)
+        self.metrics_ref = None
+        self.metrics_deadline = 0.0
+        self.next_metrics_at = 0.0
         # graceful drain state machine (DRAINING replicas only)
         self.drain_ref = None   # in-flight prepare_drain / drain_status poll
         self.finish_ref = None  # in-flight finish_drain (release_all)
@@ -176,6 +186,10 @@ class _ProxyState:
         self.ping_ref = None
         self.ping_deadline = 0.0
         self.next_ping_at = 0.0
+        # fleet metrics_report polling (obs.clock timeline)
+        self.metrics_ref = None
+        self.metrics_deadline = 0.0
+        self.next_metrics_at = 0.0
 
 
 class ServeController:
@@ -230,6 +244,15 @@ class ServeController:
             "checkpoint did not know them (mutation crashed before its "
             "checkpoint landed, or their app was deleted mid-outage)",
         )
+        # fleet metrics plane (ISSUE 13): per-source collect_families()
+        # snapshots merged + ring-buffered here. Deliberately NOT in the
+        # crash checkpoint — the history's job is surviving REPLICA death
+        # (the aggregator never forgets a source), while a controller
+        # restart re-primes it within one poll period anyway.
+        self._fleet = metrics.FleetAggregator(
+            history_samples=_FLEET_HISTORY_SAMPLES
+        )
+        self._next_self_ingest = 0.0
         # crash-recovery checkpointing: _ckpt_io_lock serializes writers
         # (RPC threads + reconciler) so a slow write can't be overtaken
         # by a staler snapshot; _ckpt_dirty marks a failed write for the
@@ -438,6 +461,27 @@ class ServeController:
             return {nid.hex(): ps.state
                     for nid, ps in self._proxies.items()}
 
+    def fleet_metrics(self) -> dict:
+        """Fleet metrics plane snapshot: merged families (per-source
+        relabeled series first, then rollups with ``replica_id`` dropped)
+        plus the Prometheus text rendering — the dashboard serves the
+        text at ``/metrics/fleet`` verbatim — and source provenance."""
+        fams = self._fleet.fleet_families()
+        return {
+            "families": fams,
+            "text": metrics.render_prometheus(fams),
+            "sources": self._fleet.sources(),
+        }
+
+    def fleet_history(
+        self, series: str | None = None, prefix: str | None = None
+    ) -> dict:
+        """Ring-buffer time series ``{series_key: [(stamp, value), ...]}``
+        stamped on the controller's obs.clock. Sources are never
+        forgotten, so series of killed replicas stay queryable — the
+        post-mortem counterpart of the live scrape."""
+        return self._fleet.history(series=series, prefix=prefix)
+
     def shutdown(self) -> None:
         self._stopped.set()
         # drop the checkpoint FIRST: an intentional teardown must not be
@@ -512,6 +556,8 @@ class ServeController:
             changed |= self._reconcile_deployment(app_name, name, ds)
         if proxy_cfg is not None:
             self._reconcile_proxies(proxy_cfg)
+            self._poll_proxy_metrics()
+        self._ingest_self_metrics()
         with self._lock:
             if changed:
                 self._version += 1
@@ -705,6 +751,10 @@ class ServeController:
                 changed = True
         # 2. health-check RUNNING replicas via ping round-trips
         changed |= self._health_check(ds)
+        # 2b. fleet metrics plane: pull metrics_report from every live
+        # replica — unconditional, unlike the autoscaling snapshots (every
+        # ReplicaActor exposes it; no capability gate, no decider needed)
+        self._poll_fleet_metrics(app_name, name, ds)
         # 3. crash-loop detection: repeated death-before-RUNNING means the
         # user code fails at startup — stop respawning, mark UNHEALTHY
         if ds.consecutive_start_failures >= _MAX_CONSECUTIVE_START_FAILURES:
@@ -886,6 +936,105 @@ class ServeController:
                     r.snapshot_deadline = now + _SNAPSHOT_TIMEOUT_S
                 except Exception:  # noqa: BLE001 — dead; step 1 reaps it
                     pass
+
+    def _poll_fleet_metrics(
+        self, app_name: str, name: str, ds: _DeploymentState
+    ) -> None:
+        """Pull ``metrics_report()`` from every live replica into the
+        fleet aggregator, non-blocking (same ref discipline as pings and
+        snapshot polls: a slow replica must not stall the reconcile
+        loop). Reports are ingested with the CONTROLLER's obs.clock as
+        the stamp — per-process perf_counter timelines aren't comparable
+        across actors, so last-write ordering and history stamps ride one
+        clock: ours. Dispatched actor-level (not rt_call): the poll must
+        never queue behind a saturated data plane. DRAINING replicas
+        still report — their in-flight streams keep moving counters until
+        retirement, and the history keeps their series after it."""
+        pool_role = getattr(ds.config, "pool_role", None) or ""
+        now = obs.clock()
+        for r in list(ds.replicas):
+            if r.state not in ("RUNNING", "DRAINING"):
+                continue
+            if r.metrics_ref is not None:
+                if self._ref_ready(r.metrics_ref):
+                    try:
+                        rep = ray_tpu.get(r.metrics_ref, timeout=5)
+                        self._fleet.ingest(
+                            f"replica:{r.actor_id.hex()}",
+                            rep["families"],
+                            {
+                                "app": app_name,
+                                "deployment": name,
+                                "replica_id": r.actor_id.hex(),
+                                "pool_role": pool_role,
+                            },
+                            stamp=now,
+                        )
+                    except Exception:  # noqa: BLE001 — dead/failing
+                        pass           # replica; the health check owns it
+                    r.metrics_ref = None
+                    r.next_metrics_at = now + _FLEET_PERIOD_S
+                elif now > r.metrics_deadline:
+                    r.metrics_ref = None
+                    r.next_metrics_at = now + _FLEET_PERIOD_S
+            elif now >= r.next_metrics_at:
+                try:
+                    r.metrics_ref = r.handle.metrics_report.remote()
+                    r.metrics_deadline = now + _FLEET_TIMEOUT_S
+                except Exception:  # noqa: BLE001 — dead; step 1 reaps it
+                    pass
+
+    def _poll_proxy_metrics(self) -> None:
+        """Same non-blocking metrics_report pull over HEALTHY per-node
+        proxies — the serve_* ingress counters (shed responses, access
+        status codes) live in proxy processes, not in any replica."""
+        now = obs.clock()
+        with self._lock:
+            current = list(self._proxies.items())
+        for nid, ps in current:
+            if ps.state != "HEALTHY" or ps.handle is None:
+                continue
+            if ps.metrics_ref is not None:
+                if self._ref_ready(ps.metrics_ref):
+                    try:
+                        rep = ray_tpu.get(ps.metrics_ref, timeout=5)
+                        self._fleet.ingest(
+                            f"proxy:{nid.hex()}",
+                            rep["families"],
+                            {
+                                "deployment": "_proxy",
+                                "replica_id": f"proxy:{nid.hex()[:12]}",
+                            },
+                            stamp=now,
+                        )
+                    except Exception:  # noqa: BLE001 — dead/failing
+                        pass           # proxy; its ping path owns it
+                    ps.metrics_ref = None
+                    ps.next_metrics_at = now + _FLEET_PERIOD_S
+                elif now > ps.metrics_deadline:
+                    ps.metrics_ref = None
+                    ps.next_metrics_at = now + _FLEET_PERIOD_S
+            elif now >= ps.next_metrics_at:
+                try:
+                    ps.metrics_ref = ps.handle.metrics_report.remote()
+                    ps.metrics_deadline = now + _FLEET_TIMEOUT_S
+                except Exception:  # noqa: BLE001 — dead; reaped above
+                    pass
+
+    def _ingest_self_metrics(self) -> None:
+        """Fold the controller's OWN registry (autoscale targets, drain
+        gauges, recovery counters) into the fleet plane, so one scrape
+        target really does cover the whole control+data plane."""
+        now = obs.clock()
+        if now < self._next_self_ingest:
+            return
+        self._next_self_ingest = now + _FLEET_PERIOD_S
+        self._fleet.ingest(
+            "controller",
+            metrics.collect_families(),
+            {"deployment": "_controller", "replica_id": "controller"},
+            stamp=now,
+        )
 
     def _aggregate_signals(self, ds: _DeploymentState) -> list[dict]:
         """Fresh snapshots, one per RUNNING replica (stale or orphaned

@@ -114,6 +114,10 @@ _DONE = object()  # stream sentinel
 # deadline-miss / rejection event timestamps into rates.
 _SIGNAL_RATE_WINDOW_S = 30.0
 
+# Window (obs.clock seconds) of per-step (device-time, tokens) samples
+# behind the llm_goodput_tokens_per_sec / llm_serving_mfu gauges.
+_GOODPUT_WINDOW_S = 30.0
+
 
 def _pctile(samples, q: float) -> float:
     """Nearest-rank percentile of a small sample window; 0.0 when empty."""
@@ -556,6 +560,18 @@ class LLMEngine:
             "Fraction of the usable KV pool a new admission cannot claim "
             "(allocations + reservations + quarantine)",
         )
+        # ---- serving goodput / MFU accounting (ISSUE 13) ----
+        # Analytic forward FLOPs per token: 2 FLOPs per weight
+        # (multiply+accumulate), the serving-side counterpart of the
+        # training 6N rule (docs/ROOFLINE.md, benchmarks/gpt_mfu.py).
+        self._flops_per_token = 2.0 * self.executor.num_params
+        self._peak_flops = self.executor.peak_tflops * 1e12
+        # per step kind: ring of (clock, device_s, tokens) step samples
+        # plus the last derived rates, for stats()/the decode bench
+        self._goodput_windows: dict[str, deque] = {}
+        self._goodput_last: dict[str, dict] = {}
+        self._m_goodput = obs.goodput_gauge()
+        self._m_mfu = obs.mfu_gauge()
         # count compile events by shape key as DecodeFns sees new
         # signatures (attribute hook, forwarded through the executor —
         # DecodeFns stays constructible bare)
@@ -826,6 +842,9 @@ class LLMEngine:
                 "spec_committed_per_step": (
                     self._spec_committed_total / max(1, self._spec_steps)
                 ),
+                "goodput": {
+                    k: dict(v) for k, v in self._goodput_last.items()
+                },
                 "executor": self.executor.describe(),
                 "failed": self._failed is not None,
             }
@@ -1268,6 +1287,7 @@ class LLMEngine:
         self._m_util.set(self.cache.utilization)
         self._sync_cache_counters_locked()
         self._m_latency.observe(dt, tags={"kind": kind})
+        self._goodput_record_locked(kind, dt, int(sum(ns)))
         event_stats.record(f"llm.engine.step.{kind}", dt)
         self._flight_record_locked(
             kind, t0_wall, dt, batch=len(batch), bucket_b=B, bucket_len=S,
@@ -1340,6 +1360,7 @@ class LLMEngine:
             self._m_util.set(self.cache.utilization)
             self._sync_cache_counters_locked()
             self._m_latency.observe(dt, tags={"kind": "decode"})
+            self._goodput_record_locked("decode", dt, emitted)
             event_stats.record("llm.engine.step.decode", dt)
             self._flight_record_locked(
                 "decode", t0_wall, dt, batch=0, tokens=emitted,
@@ -1405,6 +1426,7 @@ class LLMEngine:
         self._m_util.set(self.cache.utilization)
         self._sync_cache_counters_locked()
         self._m_latency.observe(dt, tags={"kind": "decode"})
+        self._goodput_record_locked("decode", dt, emitted)
         self._decode_step_window.append(dt)
         event_stats.record("llm.engine.step.decode", dt)
         self._flight_record_locked(
@@ -1561,6 +1583,7 @@ class LLMEngine:
         self._m_util.set(self.cache.utilization)
         self._sync_cache_counters_locked()
         self._m_latency.observe(dt, tags={"kind": "verify"})
+        self._goodput_record_locked("verify", dt, emitted + step_tokens)
         self._decode_step_window.append(dt)
         event_stats.record("llm.engine.step.verify", dt)
         self._flight_record_locked(
@@ -1608,6 +1631,46 @@ class LLMEngine:
             "sync_lag": lag,
         }
         return toks
+
+    def _goodput_record_locked(self, kind: str, dt: float,
+                               tokens: int) -> None:
+        """Fold one step's (device-time, tokens) sample into the windowed
+        ``llm_goodput_tokens_per_sec`` / ``llm_serving_mfu`` gauges for
+        its kind. ``dt`` is the step's one-clock duration — on the
+        pipelined steady path the lag-1 sync means it approximates ONE
+        device step (dispatching N+1 overlaps executing N), which is
+        exactly the attribution a utilization gauge wants; on lag-0
+        paths (prefill, verify, drain) it includes the blocking sync
+        (docs/OBSERVABILITY.md, "lag-1 caveat"). MFU is goodput times
+        the analytic 2N forward FLOPs/token over the executor's peak
+        FLOP rate. O(window) amortized: one append + horizon prune."""
+        now = obs.clock()
+        win = self._goodput_windows.get(kind)
+        if win is None:
+            win = self._goodput_windows[kind] = deque(maxlen=1024)
+        win.append((now, float(dt), int(tokens)))
+        horizon = now - _GOODPUT_WINDOW_S
+        while win and win[0][0] < horizon:
+            win.popleft()
+        dev_s = sum(s[1] for s in win)
+        toks = sum(s[2] for s in win)
+        if dev_s <= 0.0 or toks <= 0:
+            return
+        tps = toks / dev_s
+        mfu = (
+            tps * self._flops_per_token / self._peak_flops
+            if self._peak_flops > 0.0
+            else 0.0
+        )
+        self._m_goodput.set(tps, tags={"kind": kind})
+        self._m_mfu.set(mfu, tags={"kind": kind})
+        self._goodput_last[kind] = {
+            "tokens_per_sec": round(tps, 3),
+            "mfu": round(mfu, 6),
+            "window_steps": len(win),
+            "window_device_s": round(dev_s, 6),
+            "window_tokens": toks,
+        }
 
     def _sample_args_locked(self, batch: list, B: int) -> dict:
         """Per-row sampling controls as [B] host staging arrays — the

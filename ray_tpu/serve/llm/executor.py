@@ -285,6 +285,42 @@ class ModelExecutor:
     # ---------------- introspection ----------------
 
     @property
+    def num_params(self) -> int:
+        """Parameter count of the weights THIS executor serves, summed
+        from the params pytree's shape metadata (no device sync) — the
+        analytic-FLOPs input for serving MFU (2*n_params FLOPs/token,
+        forward-only; cf. the training side's 6*n_params in
+        benchmarks/gpt_mfu.py and docs/ROOFLINE.md)."""
+        import jax
+
+        if getattr(self, "_num_params", None) is None:
+            self._num_params = int(sum(
+                x.size for x in jax.tree_util.tree_leaves(self.params)
+            ))
+        return self._num_params
+
+    @property
+    def peak_tflops(self) -> float:
+        """Aggregate peak bf16 TFLOP/s across this executor's devices —
+        the MFU denominator. Reuses the per-chip table the training
+        benchmarks publish against (benchmarks/gpt_mfu.py); on CPU the
+        nominal 0.5 TFLOP/s keeps the ratio defined (not meaningful as a
+        hardware ceiling, but nonzero and stable for CI)."""
+        from ray_tpu.benchmarks.gpt_mfu import chip_peak_tflops
+
+        if getattr(self, "_peak_tflops", None) is None:
+            dev = self._devices()[0]
+            self._peak_tflops = (
+                chip_peak_tflops(dev) * float(self.num_devices)
+            )
+        return self._peak_tflops
+
+    def _devices(self):
+        import jax
+
+        return jax.devices()
+
+    @property
     def attention_backend(self) -> str:
         """The RESOLVED decode-attention backend the jitted model steps
         traced with ("xla" | "pallas") — the model config's knob with
@@ -424,6 +460,9 @@ class ShardedExecutor(ModelExecutor):
     @property
     def num_devices(self) -> int:
         return self.mesh.devices.size
+
+    def _devices(self):
+        return list(self.mesh.devices.flat)
 
     def describe(self) -> dict:
         return {

@@ -92,6 +92,24 @@ def sync_bytes_counter() -> metrics.Counter:
     )
 
 
+def goodput_gauge() -> metrics.Gauge:
+    return metrics.gauge(
+        "llm_goodput_tokens_per_sec",
+        "Windowed serving goodput: tokens retired per second of "
+        "attributed device time, by step kind",
+        tag_keys=("kind",),
+    )
+
+
+def mfu_gauge() -> metrics.Gauge:
+    return metrics.gauge(
+        "llm_serving_mfu",
+        "Windowed serving model-FLOPs utilization: goodput x 2*n_params "
+        "FLOPs/token over the executor's peak FLOP rate, by step kind",
+        tag_keys=("kind",),
+    )
+
+
 def compile_counter() -> metrics.Counter:
     return metrics.counter(
         "llm_compile_events",

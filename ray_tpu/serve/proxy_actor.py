@@ -59,6 +59,18 @@ class ProxyActor:
             out["grpc"] = (self._grpc.options.host, self._grpc.port)
         return out
 
+    def metrics_report(self) -> dict:
+        """Fleet-plane snapshot of this proxy process's registry (the
+        serve_* ingress counters live here, not in any replica). Same
+        shape as ReplicaActor.metrics_report."""
+        from ray_tpu.util import metrics
+
+        return {
+            "clock": time.perf_counter(),
+            "wall": time.time(),
+            "families": metrics.collect_families(),
+        }
+
     def stop(self) -> str:
         self._stopped.set()
         if self._http is not None:

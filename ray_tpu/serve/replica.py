@@ -10,12 +10,14 @@ from __future__ import annotations
 
 import inspect
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any
 
 import ray_tpu
 from ray_tpu._private import task_spec as ts
 from ray_tpu.serve.batching import get_batch_config, pad_to_bucket
+from ray_tpu.util import metrics
 
 
 class _ReplicaBatchQueue:
@@ -208,6 +210,19 @@ class ReplicaActor:
                 not self._is_function
                 and callable(getattr(self._instance, "prepare_drain", None))
             ),
+            "has_metrics_report": True,
+        }
+
+    def metrics_report(self) -> dict:
+        """Cheap snapshot for the controller's fleet metrics plane: this
+        replica process's whole registry as kind-preserving families plus
+        a freshness stamp. Same clocks as serve/llm obs — perf_counter
+        for the monotonic stamp, wall time for display. Actor-level (not
+        rt_call), so the poll never queues behind user traffic."""
+        return {
+            "clock": time.perf_counter(),
+            "wall": time.time(),
+            "families": metrics.collect_families(),
         }
 
     # -- data surface --

@@ -94,6 +94,96 @@ def test_metrics_counter_gauge_histogram():
         c.inc()  # missing tag
 
 
+def test_metrics_server_ephemeral_port_scrapable():
+    """start_metrics_server(port=0) binds an ephemeral port and returns
+    (server, port) — tests and multi-process nodes scrape without port
+    collisions (ISSUE 13 satellite)."""
+    import urllib.request
+
+    from ray_tpu.util import metrics
+
+    c = metrics.counter("rt_test_scrape_events", "scrape target check")
+    c.inc(5.0)
+    server, port = metrics.start_metrics_server(port=0, addr="127.0.0.1")
+    try:
+        assert port > 0
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        assert "rt_test_scrape_events_total 5.0" in body
+    finally:
+        server.shutdown()
+
+
+def test_metric_description_drift_warns_once(caplog):
+    """Re-registering a name with a different description keeps the
+    original instrument and warns ONCE on ray_tpu.metrics — not once per
+    get, and not for omitted descriptions (ISSUE 13 satellite)."""
+    import logging
+
+    from ray_tpu.util import metrics
+
+    first = metrics.counter("rt_test_desc_drift", "the original meaning")
+    with caplog.at_level(logging.WARNING, logger="ray_tpu.metrics"):
+        same = metrics.counter("rt_test_desc_drift", "the original meaning")
+        bare = metrics.counter("rt_test_desc_drift")  # lookup, not drift
+        drifted = metrics.counter("rt_test_desc_drift", "something else")
+        again = metrics.counter("rt_test_desc_drift", "yet another")
+    assert same is first and bare is first
+    assert drifted is first and again is first  # original kept
+    assert first.description == "the original meaning"
+    warnings = [
+        r for r in caplog.records if "rt_test_desc_drift" in r.getMessage()
+    ]
+    assert len(warnings) == 1, [r.getMessage() for r in warnings]
+
+
+def test_collect_prefix_and_prometheus_suffix_contracts():
+    """collect(prefix=) against the Prometheus naming contracts: a
+    Counter family ``X`` samples as ``X_total``; a Histogram family
+    samples as ``X_bucket{le=}`` (CUMULATIVE counts) + ``X_sum`` +
+    ``X_count`` (ISSUE 13 satellite)."""
+    from ray_tpu.util import metrics
+
+    c = metrics.counter("rt_suffix_events", "suffix check")
+    c.inc(3.0)
+    h = metrics.histogram(
+        "rt_suffix_latency_s", "suffix check", boundaries=(0.1, 1.0)
+    )
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(7.0)
+
+    snap = metrics.collect(prefix="rt_suffix_events")
+    assert snap["rt_suffix_events_total"] == 3.0
+    # collect() keeps prometheus_client's _created timestamp bookkeeping;
+    # the fleet payload (collect_families) is the layer that drops it
+    assert set(snap) == {
+        "rt_suffix_events_total", "rt_suffix_events_created",
+    }
+
+    hs = metrics.collect(prefix="rt_suffix_latency_s")
+    assert hs["rt_suffix_latency_s_count"] == 3.0
+    assert hs["rt_suffix_latency_s_sum"] == pytest.approx(7.55)
+    # buckets are cumulative: le=0.1 holds 1, le=1.0 holds 1+1, +Inf all
+    assert hs["rt_suffix_latency_s_bucket{le=0.1}"] == 1.0
+    assert hs["rt_suffix_latency_s_bucket{le=1.0}"] == 2.0
+    assert hs["rt_suffix_latency_s_bucket{le=+Inf}"] == 3.0
+    # nothing but the histogram's own samples under its prefix
+    assert set(hs) == {
+        "rt_suffix_latency_s_count",
+        "rt_suffix_latency_s_sum",
+        "rt_suffix_latency_s_created",
+        "rt_suffix_latency_s_bucket{le=0.1}",
+        "rt_suffix_latency_s_bucket{le=1.0}",
+        "rt_suffix_latency_s_bucket{le=+Inf}",
+    }
+    fam = metrics.collect_families(prefix="rt_suffix_events")
+    assert [s["name"] for s in fam["rt_suffix_events"]["samples"]] == [
+        "rt_suffix_events_total"
+    ]
+
+
 def test_collective_group_among_actors(ray_start):
     rt = ray_start
     from ray_tpu.util import collective as col

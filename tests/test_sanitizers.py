@@ -338,14 +338,21 @@ def test_one_clock_in_autoscaling_control_plane():
     checkpoint persists drain deadlines as remaining-time measured on
     obs.clock and stamps written_at/recovered_at with obs.wall, so a
     stray raw clock in _checkpoint/_recover would resume a drain
-    against a timebase the checkpoint was never measured on."""
+    against a timebase the checkpoint was never measured on.
+
+    The fleet metrics plane (ISSUE 13) rides the same rule: ingest
+    stamps order last-write gauges and the history ring, so the polling
+    functions must stamp with the controller's obs.clock — a raw clock
+    there would interleave history samples from two timebases."""
     import ast
     import pathlib
 
     root = pathlib.Path(__file__).resolve().parents[1]
     banned = {"time", "monotonic", "perf_counter"}
     aggregation_fns = frozenset(
-        {"_aggregate_inflight", "_aggregate_signals", "_poll_snapshots"})
+        {"_aggregate_inflight", "_aggregate_signals", "_poll_snapshots",
+         "_poll_fleet_metrics", "_poll_proxy_metrics",
+         "_ingest_self_metrics"})
     recovery_fns = frozenset(
         {"_recover", "_checkpoint", "_build_checkpoint_locked",
          "_adopt_replica"})
@@ -464,6 +471,67 @@ def test_decode_attention_path_never_materializes_kv():
     )
     assert not offenders, (
         f"materializing ops in the decode attention path: {offenders}"
+    )
+
+
+def test_metrics_registry_matches_observability_docs():
+    """Metrics↔docs drift lint (ISSUE 13): the table in
+    docs/OBSERVABILITY.md § Metrics claims to be the COMPLETE registry of
+    metric names registered under ray_tpu/serve/. Hold both sides to it:
+    every string literal passed to a ``counter``/``gauge``/``histogram``
+    factory in serve code must have a table row, and every ``llm_*`` /
+    ``serve_*`` name a table row documents must be registered by code —
+    an undocumented metric is invisible to operators, a documented ghost
+    sends them querying a series that never exists."""
+    import ast
+    import pathlib
+    import re
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+
+    registered: dict[str, str] = {}  # name -> first registration site
+    for path in sorted((root / "ray_tpu" / "serve").rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname not in ("counter", "gauge", "histogram"):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if re.match(r"^(llm|serve)_", name):
+                registered.setdefault(
+                    name, f"{path.relative_to(root)}:{node.lineno}")
+    assert registered, "no metric registrations found under ray_tpu/serve/"
+
+    doc = root / "docs" / "OBSERVABILITY.md"
+    documented: set[str] = set()
+    for line in doc.read_text().splitlines():
+        if not line.lstrip().startswith("|"):
+            continue  # only table rows document metrics
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        m = re.match(r"^`((?:llm|serve)_[a-z0-9_]+)(?:\{[^}]*\})?`$",
+                     cells[0]) if cells else None
+        if m:
+            documented.add(m.group(1))
+    assert documented, "no metric rows found in docs/OBSERVABILITY.md"
+
+    undocumented = {
+        n: site for n, site in registered.items() if n not in documented
+    }
+    ghosts = documented - set(registered)
+    assert not undocumented, (
+        "metrics registered without a docs/OBSERVABILITY.md row: "
+        f"{undocumented}"
+    )
+    assert not ghosts, (
+        "docs/OBSERVABILITY.md documents metrics no serve code registers: "
+        f"{sorted(ghosts)}"
     )
 
 

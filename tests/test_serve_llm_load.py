@@ -40,3 +40,12 @@ def test_chaos_load_bench_lossless(jax_cpu):
     # replica observed DRAINING
     assert out["llm_load_scale_events"] >= 1, out
     assert out["llm_load_drain_observed"] is True, out
+
+    # fleet plane crosscheck (ISSUE 13): the controller-aggregated TTFT/
+    # TPOT histograms and shed counters agree with the bench's own
+    # in-process timeline numbers, and the fleet saw the whole storyline
+    # (controller + replicas; replica sources are never forgotten, so
+    # the killed replica still counts)
+    assert out["llm_fleet_crosscheck_ok"] is True, out
+    assert out["llm_fleet_ttft_p99_ms"] is not None, out
+    assert out["llm_fleet_sources"] >= 3, out
