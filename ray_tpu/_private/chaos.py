@@ -52,6 +52,14 @@ Hook sites currently instrumented:
                         mmap cache is consulted (context: object_id hex,
                         timeout_ms — ``raise``/``delay`` here make store
                         fetch faults injectable like every other RPC)
+  ``llm.kv.demote``   — in PagedKVCache, before an LRU-evicted prefix
+                        block's content is captured into the host cache
+                        tier (context: block — ``raise`` here proves a
+                        failed spill is a lost cache entry, never a
+                        correctness event)
+  ``llm.kv.promote``  — in the engine, before a batched host->device
+                        promotion landing (context: blocks — staged
+                        record count)
 
 Plans install either in-process (``install``, for unit tests driving an
 engine directly) or via the ``RAY_TPU_CHAOS_PLAN`` environment variable

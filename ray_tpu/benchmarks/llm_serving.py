@@ -86,6 +86,24 @@ Reported (one JSON line, merged into bench.py's aux results under
                               (``run_load_bench(prefill_replicas=1)``)
                               is judged on
 
+- ``llm_fleet_prefix_hit_rate`` / ``llm_fleet_prefix_ttft_p99_ms``
+                              the fleet KV bench (``run_fleet_prefix_bench``):
+                              zipf-popular system prompts streamed over a
+                              live autoscaling multi-replica fleet with
+                              prefix-aware routing + the host KV tier on —
+                              fleet-summed hit rate over the measured wave
+                              and client-observed TTFT p99; the SAME seeded
+                              trace re-runs with RAY_TPU_PREFIX_ROUTING=0
+                              and reports under ``..._baseline`` (the routed
+                              hit rate must sit strictly above it at >=2
+                              replicas — ``llm_fleet_prefix_routing_wins``);
+                              ``llm_fleet_demoted_rehit_ttft_ms`` vs
+                              ``llm_fleet_recompute_ttft_ms`` times a
+                              demoted-prefix re-hit (host-tier promotion
+                              through the batched ``land_blocks`` drain)
+                              against recomputing an equal-length cold
+                              prefix on one engine
+
 Runs on CPU with the tiny llama config — the point is tracking the
 scheduler/cache overheads and the hit-rate plumbing release-over-release,
 not absolute TPU throughput (bench.py GPT-MFU owns that axis).
@@ -137,6 +155,35 @@ LOAD_KILL_INDEX = 2      # chunk index after which the tagged replica dies
 LOAD_LONG_FRACTION = 0.3
 LOAD_SHORT_PROMPT = (3, 9)    # uniform token-count range, inclusive-lo
 LOAD_LONG_PROMPT = (48, 81)
+# fleet prefix bench: a few distinct system prompts with zipf popularity
+# streamed over a live >=2-replica fleet. Prefix length is a multiple of
+# block_size so the whole system prompt registers as full chain-digest
+# blocks; the settle window covers the controller's 0.5 s snapshot poll
+# plus the router's 0.25 s table refresh so replica summaries are live
+# before the measured wave.
+FLEET_SEED = 13
+FLEET_PREFIXES = 4
+FLEET_PREFIX_TOKENS = 64
+FLEET_TAIL_TOKENS = 4
+FLEET_REQUESTS = 24
+FLEET_NEW_TOKENS = 6
+FLEET_ZIPF_S = 1.1
+FLEET_SETTLE_S = 2.5
+FLEET_REHIT_ITERS = 3
+# the re-hit phase runs a default-size llama (small vocab, rehit config
+# below): on the tiny config a CPU prefill costs ~2 ms — less than the
+# fixed unpack+land cost of a promotion plus the engine's per-step
+# overhead, so the comparison would only say "tiny models recompute
+# faster": true and useless. At 8 layers / d_model 512 the recomputed
+# prefix pays real attention/MLP flops, the regime the spill tier
+# exists for, while the promotion stays one batched land. Churn REUSES
+# the same filler content every cycle so steady-state evictions of
+# filler blocks hit the already-backed fast path (host entry refresh,
+# no re-export) — the measured windows then contain the work being
+# compared, not demote capture of churn traffic.
+FLEET_REHIT_PREFIX_TOKENS = 192
+FLEET_REHIT_POOL_BLOCKS = 36
+FLEET_REHIT_CHURN = 12
 
 
 def _ensure_virtual_devices(n: int) -> None:
@@ -621,6 +668,232 @@ def _fleet_hist_p99_ms(families: dict, family: str):
     )
 
 
+def run_fleet_prefix_bench() -> dict:
+    """Fleet-scale KV caching: prefix-aware routing + the pinned host
+    tier, measured end to end.
+
+    Phase 1 — fleet wave, twice. A seeded zipf schedule over
+    ``FLEET_PREFIXES`` distinct system prompts streams through a live
+    autoscaling fleet (min 2 replicas). One warm pass pins each prefix
+    onto whichever replica the load balancer picked, a settle window
+    lets the replicas' chain-digest summaries ride the controller poll
+    into every router table, then the measured wave runs request by
+    request. Hit rate is the fleet-summed ``prefix_hit_tokens`` delta
+    over prefill tokens retired during the wave; TTFT is client-observed
+    dispatch -> first chunk. The IDENTICAL trace then re-runs on a fresh
+    fleet with ``RAY_TPU_PREFIX_ROUTING=0`` — pure least-loaded
+    placement scatters repeat prefixes across replicas, so the routed
+    hit rate must sit strictly above this baseline whenever >=2 replicas
+    are serving.
+
+    Phase 2 — demoted re-hit vs recompute, one engine. A prefix is
+    warmed, LRU-churned into the host tier, then re-hit: the prefill
+    promotes its blocks back through the batched ``land_blocks`` drain
+    and only computes the tail. Median TTFT of that re-hit is compared
+    against recomputing a fresh equal-length prefix — the number that
+    says the spill tier actually buys latency, not just capacity."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.handle import _PREFIX_ROUTING_ENV
+    from ray_tpu.serve.llm import (
+        EngineConfig, LLMEngine, build_llm_app, stream_tokens,
+    )
+    from ray_tpu.util import metrics as _metrics
+
+    mc = dataclasses.replace(
+        LlamaConfig.tiny(), dtype=jnp.float32, attention="xla")
+    ecfg = EngineConfig(
+        model="llama", model_config=mc, seed=0,
+        block_size=8, num_blocks=128, host_cache_bytes=1 << 24,
+    )
+
+    # one seeded trace, replayed verbatim for both runs
+    rng = np.random.default_rng(FLEET_SEED)
+    prefixes = [
+        [int(t) for t in rng.integers(1, mc.vocab_size, FLEET_PREFIX_TOKENS)]
+        for _ in range(FLEET_PREFIXES)
+    ]
+    weights = np.array(
+        [1.0 / (k + 1) ** FLEET_ZIPF_S for k in range(FLEET_PREFIXES)])
+    weights /= weights.sum()
+    wave = []
+    for _ in range(FLEET_REQUESTS):
+        pick = int(rng.choice(FLEET_PREFIXES, p=weights))
+        tail = [int(t) for t in rng.integers(1, mc.vocab_size,
+                                             FLEET_TAIL_TOKENS)]
+        wave.append((pick, tail))
+    warm_tails = [
+        [int(t) for t in rng.integers(1, mc.vocab_size, FLEET_TAIL_TOKENS)]
+        for _ in range(FLEET_PREFIXES)
+    ]
+
+    def _router_hits() -> float:
+        """Driver-process router counter: dispatches steered by prefix
+        match (the handle lives HERE, not on a replica)."""
+        fam = _metrics.collect_families().get("llm_router_prefix_hits")
+        if not fam:
+            return 0.0
+        return sum(
+            s["value"] for s in fam["samples"]
+            if s["name"] == "llm_router_prefix_hits_total"
+        )
+
+    def _fleet_sum(replies: list, key: str) -> int:
+        return sum(int(st[key]) for st in replies if st)
+
+    def fleet_run(enabled: bool) -> dict:
+        prev = os.environ.get(_PREFIX_ROUTING_ENV)
+        os.environ[_PREFIX_ROUTING_ENV] = "1" if enabled else "0"
+        ray_tpu.init(num_cpus=8)
+        try:
+            handle = serve.run(
+                build_llm_app(ecfg, autoscaling_config=dict(
+                    min_replicas=2, max_replicas=3,
+                    # the zipf wave is light; never let a policy
+                    # scale-down shrink the fleet mid-measurement
+                    downscale_delay_periods=10_000,
+                )),
+                name="llm-prefix-fleet", timeout_s=300,
+            )
+
+            def consume(prompt, rid):
+                t0 = time.perf_counter()
+                first = None
+                for _ in stream_tokens(handle, {
+                    "prompt": prompt, "request_id": rid,
+                    "max_new_tokens": FLEET_NEW_TOKENS,
+                }):
+                    if first is None:
+                        first = time.perf_counter()
+                return (first - t0) if first is not None else None
+
+            for k, prefix in enumerate(prefixes):
+                consume(prefix + warm_tails[k], f"warm-{k}")
+            # let every replica's summary ride one snapshot poll into
+            # the controller and one table refresh into this router
+            time.sleep(FLEET_SETTLE_S)
+            before = handle.broadcast("stats")
+            hits0 = _router_hits()
+            ttfts = [
+                consume(prefixes[pick] + tail, f"wave-{i}")
+                for i, (pick, tail) in enumerate(wave)
+            ]
+            after = handle.broadcast("stats")
+        finally:
+            serve.shutdown()
+            ray_tpu.shutdown()
+            if prev is None:
+                os.environ.pop(_PREFIX_ROUTING_ENV, None)
+            else:
+                os.environ[_PREFIX_ROUTING_ENV] = prev
+        d_hit = (_fleet_sum(after, "prefix_hit_tokens")
+                 - _fleet_sum(before, "prefix_hit_tokens"))
+        d_computed = (_fleet_sum(after, "prefill_tokens_total")
+                      - _fleet_sum(before, "prefill_tokens_total"))
+        ttfts = [t for t in ttfts if t is not None]
+        return {
+            "hit_rate": round(d_hit / max(d_hit + d_computed, 1), 4),
+            "ttft_p99_ms": round(
+                float(np.percentile(ttfts, 99)) * 1e3, 3
+            ) if ttfts else None,
+            "router_hits": _router_hits() - hits0,
+            "replicas": sum(1 for st in after if st),
+        }
+
+    routed = fleet_run(True)
+    baseline = fleet_run(False)
+
+    # -- phase 2: demoted-prefix re-hit vs recompute on one engine --
+    rehit_mc = dataclasses.replace(
+        LlamaConfig.tiny(), max_seq_len=256, n_layer=8, n_head=8,
+        d_model=512, d_mlp=1408, dtype=jnp.float32, attention="xla",
+    )
+    eng = LLMEngine(
+        EngineConfig(
+            model="llama", model_config=rehit_mc, seed=0,
+            block_size=8, num_blocks=FLEET_REHIT_POOL_BLOCKS,
+            max_batch_size=4, max_prefill_batch=4,
+            host_cache_bytes=1 << 24,
+        ),
+        auto_step=False,
+    )
+    rr = np.random.default_rng(FLEET_SEED + 1)
+    prefix = [int(t) for t in rr.integers(
+        1, rehit_mc.vocab_size, FLEET_REHIT_PREFIX_TOKENS)]
+
+    def drain(stream):
+        while not stream.done and eng.step():
+            pass
+        while eng.step():  # collapse the trailing in-flight step
+            pass
+        list(stream)
+
+    def churn() -> None:
+        """Fill the pool so LRU eviction demotes the prefix. Constant
+        filler content: after the first cycle the fillers' blocks are
+        host-backed, so evicting them again is an arena refresh, not a
+        fresh demote export — the timed windows stay clean."""
+        for i in range(FLEET_REHIT_CHURN):
+            drain(eng.submit([100 + i] * 17, max_new_tokens=4))
+
+    def tail4() -> list[int]:
+        return [int(t) for t in rr.integers(1, rehit_mc.vocab_size, 4)]
+
+    def ttft_of(prompt) -> float:
+        s = eng.submit(prompt, max_new_tokens=4)
+        drain(s)
+        tl = eng.request_timeline(s.request_id)
+        submitted = next(
+            e["ts"] for e in tl["events"] if e["event"] == "submitted")
+        first = next(
+            e["ts"] for e in tl["events"]
+            if e["event"] in ("first_token", "token"))
+        return first - submitted
+
+    drain(eng.submit(prefix + tail4(), max_new_tokens=4))  # warm + compile
+    churn()                             # demote the prefix to the host tier
+    drain(eng.submit(prefix + tail4(), max_new_tokens=4))  # compile the
+    churn()                             # promoted-tail prefill bucket too
+    rehit_s, recompute_s = [], []
+    for _ in range(FLEET_REHIT_ITERS):
+        fresh = [int(t) for t in rr.integers(
+            1, rehit_mc.vocab_size, FLEET_REHIT_PREFIX_TOKENS)]
+        recompute_s.append(ttft_of(fresh + tail4()))
+        churn()                         # re-demote before the re-hit
+        rehit_s.append(ttft_of(prefix + tail4()))
+    st = eng.stats()
+    eng.shutdown()
+
+    return {
+        "llm_fleet_prefix_hit_rate": routed["hit_rate"],
+        "llm_fleet_prefix_ttft_p99_ms": routed["ttft_p99_ms"],
+        "llm_fleet_prefix_hit_rate_baseline": baseline["hit_rate"],
+        "llm_fleet_prefix_ttft_p99_ms_baseline": baseline["ttft_p99_ms"],
+        "llm_fleet_prefix_routing_wins": bool(
+            routed["replicas"] >= 2
+            and routed["hit_rate"] > baseline["hit_rate"]
+        ),
+        "llm_fleet_router_prefix_hits": routed["router_hits"],
+        "llm_fleet_replicas": routed["replicas"],
+        "llm_fleet_demoted_rehit_ttft_ms": round(
+            float(np.percentile(rehit_s, 50)) * 1e3, 3),
+        "llm_fleet_recompute_ttft_ms": round(
+            float(np.percentile(recompute_s, 50)) * 1e3, 3),
+        "llm_fleet_rehit_faster": bool(
+            float(np.percentile(rehit_s, 50))
+            < float(np.percentile(recompute_s, 50))
+        ),
+        "llm_fleet_rehit_promoted_blocks": st["kv_promoted_blocks"],
+    }
+
+
 def run_load_bench(prefill_replicas: int = 0) -> dict:
     """Multi-replica chaos load harness: open-loop seeded bursty traffic
     through a kill + graceful drain + signal-driven autoscale event.
@@ -956,7 +1229,9 @@ def main() -> None:
             PAGED_ATTN_GQA_SHAPE, prefix="llm_paged_attn_gqa"
         )
     )
-    # last: the load harness owns a full ray_tpu cluster lifecycle
+    # cluster-lifecycle phases last: each owns a full ray_tpu
+    # init/serve.run/shutdown cycle
+    out.update(run_fleet_prefix_bench())
     out.update(run_load_bench())
     print(json.dumps({"llm_serving": out}), flush=True)
 

@@ -74,7 +74,16 @@ def snapshot_is_hot(config: AutoscalingConfig, snap: Mapping) -> bool:
         if snap.get("rejection_rate", 0.0) > 0.0:
             return True
     if mode in ("all", "decode"):
-        if snap.get("kv_pool_pressure", 0.0) >= config.upscale_kv_pressure:
+        # KV pressure sees both cache tiers: kv_pressure_two_tier
+        # discounts device pressure by host-resident (cheaply promotable)
+        # blocks, so a replica whose misses are host-tier promotes
+        # doesn't demand a new replica the way a recompute-bound one
+        # does. Engines without the host tier — and pre-tier snapshots —
+        # report the two values equal, so behavior is unchanged there.
+        pressure = snap.get(
+            "kv_pressure_two_tier", snap.get("kv_pool_pressure", 0.0)
+        )
+        if pressure >= config.upscale_kv_pressure:
             return True
         if (snap.get("deadline_miss_rate", 0.0)
                 > config.upscale_deadline_miss_rate):

@@ -357,6 +357,27 @@ class ServeController:
             for app_name, app in self._apps.items():
                 deps = {}
                 for name, ds in app["deployments"].items():
+                    # Prefix-aware routing piggyback: each LLM replica's
+                    # autoscaling snapshot carries a bounded digest
+                    # summary of the prefixes its two cache tiers can
+                    # serve (engine.prefix_digest_summary), plus the
+                    # block_size/vocab_size constants a router needs to
+                    # hash raw prompts into the same chain-digest space.
+                    # Non-LLM deployments never report the field, so
+                    # their tables stay exactly as before.
+                    running = {
+                        r.actor_id.binary()
+                        for r in ds.replicas if r.state == "RUNNING"
+                    }
+                    summaries = {}
+                    prefix_block = prefix_vocab = None
+                    for aid, (_, snap) in ds.snapshots.items():
+                        digests = snap.get("prefix_digests")
+                        if digests is None or aid not in running:
+                            continue
+                        summaries[aid] = list(digests)
+                        prefix_block = snap.get("block_size", prefix_block)
+                        prefix_vocab = snap.get("vocab_size", prefix_vocab)
                     deps[name] = {
                         "replicas": [
                             r.handle for r in ds.replicas if r.state == "RUNNING"
@@ -369,6 +390,9 @@ class ServeController:
                         # doomed requests shed at the edge (503+Retry-After)
                         # instead of queueing behind a saturated fleet
                         "shed": ds.shed,
+                        "prefix_summaries": summaries,
+                        "prefix_block_size": prefix_block,
+                        "prefix_vocab_size": prefix_vocab,
                     }
                 out["apps"][app_name] = {
                     "ingress": app["ingress"],
@@ -755,6 +779,11 @@ class ServeController:
         # replica — unconditional, unlike the autoscaling snapshots (every
         # ReplicaActor exposes it; no capability gate, no decider needed)
         self._poll_fleet_metrics(app_name, name, ds)
+        # 2c. engine-signal snapshots — polled for every signal-capable
+        # deployment (the method self-gates), not just autoscaling ones:
+        # the snapshot now carries the prefix-digest summary that feeds
+        # prefix-aware routing, which a fixed-size fleet wants too
+        self._poll_snapshots(ds)
         # 3. crash-loop detection: repeated death-before-RUNNING means the
         # user code fails at startup — stop respawning, mark UNHEALTHY
         if ds.consecutive_start_failures >= _MAX_CONSECUTIVE_START_FAILURES:
@@ -771,7 +800,6 @@ class ServeController:
         # exports AutoscalingSnapshot (serve.llm), router-reported
         # in-flight load otherwise
         if ds.decider is not None:
-            self._poll_snapshots(ds)
             running = sum(1 for r in ds.replicas if r.state == "RUNNING")
             new_target = ds.target
             if ds.signal_capable:

@@ -12,6 +12,7 @@ callers from different processes share one padded batch.
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
@@ -46,6 +47,19 @@ _RESUME_OVERLOAD_RETRY_S = 10.0
 # doubling per attempt up to cap, each jittered into [span/2, span]
 _RESUME_BACKOFF_BASE_S = 0.05
 _RESUME_BACKOFF_CAP_S = 1.0
+# --- prefix-aware routing (fleet-scale KV caching) ---
+# compute at most this many leading chain digests per dispatch: deeper
+# matches are indistinguishable to the router, and the per-replica
+# summary the controller ships is itself bounded
+_PREFIX_MATCH_BLOCKS = 16
+# load-balance escape hatch: honor the longest prefix match only while
+# the target's tracked in-flight load is within this many requests of
+# the least-loaded candidate — past that, fall back to power-of-two so
+# a hot prefix cannot hotspot one replica
+_PREFIX_MAX_SKEW = 4
+# "0" disables prefix preference (the bench's private-cache baseline);
+# re-read at every table refresh, so flipping it needs no new router
+_PREFIX_ROUTING_ENV = "RAY_TPU_PREFIX_ROUTING"
 
 
 def resume_backoff_s(seed: int, attempt: int, *,
@@ -320,6 +334,24 @@ class _Router:
             "Requests shed at admission while the fleet is saturated",
             tag_keys=("app", "deployment"),
         )
+        # Seeded tie-break RNG: routers replay identical choice sequences
+        # under the chaos harness (module-level random would interleave
+        # with every other consumer in the process).
+        self._rng = random.Random(zlib.crc32(self.router_id.encode()))
+        # prefix-aware routing state, refreshed with the table:
+        # actor id -> frozenset of hex chain digests its caches hold
+        self._prefix_summaries: dict[bytes, frozenset] = {}
+        self._prefix_block_size: int | None = None
+        self._prefix_vocab_size: int | None = None
+        self._prefix_routing = (
+            os.environ.get(_PREFIX_ROUTING_ENV, "1") != "0"
+        )
+        self._m_prefix_hits = metrics.counter(
+            "llm_router_prefix_hits",
+            "Dispatches routed to the replica holding the longest "
+            "matching prefix chain",
+            tag_keys=("app", "deployment"),
+        )
 
     # -- table management --
 
@@ -391,6 +423,15 @@ class _Router:
             self._stream_methods = set(dep.get("stream_methods", ()))
             self._max_ongoing = dep["max_ongoing_requests"]
             self._shed = bool(dep.get("shed", False))
+            self._prefix_summaries = {
+                aid: frozenset(digests)
+                for aid, digests in (dep.get("prefix_summaries") or {}).items()
+            }
+            self._prefix_block_size = dep.get("prefix_block_size")
+            self._prefix_vocab_size = dep.get("prefix_vocab_size")
+            self._prefix_routing = (
+                os.environ.get(_PREFIX_ROUTING_ENV, "1") != "0"
+            )
             self._table_at = time.monotonic()
             self._ctrl_attempt = 0
             self._next_ctrl_retry = 0.0
@@ -419,11 +460,20 @@ class _Router:
             if worker.store.status(ObjectID(oid)) != "missing":
                 self._decrement(oid)
 
-    def _pick_replica(self, deadline: float, exclude: frozenset = frozenset()):
-        """Power of two choices over tracked in-flight counts. ``exclude``
-        holds actor ids (bytes) of replicas the caller knows are dead —
-        the failover path skips them until the controller's reconcile
-        removes them from the routing table."""
+    def _pick_replica(self, deadline: float, exclude: frozenset = frozenset(),
+                      prefix_digests: tuple | None = None):
+        """Prefix-aware placement over power-of-two load balancing.
+        ``exclude`` holds actor ids (bytes) of replicas the caller knows
+        are dead — the failover path skips them until the controller's
+        reconcile removes them from the routing table; it COMPOSES with
+        the prefix preference (dead replicas are filtered first, then the
+        prefix scorer runs over the survivors) rather than bypassing it.
+        When ``prefix_digests`` names the prompt's leading chain digests,
+        the replica whose advertised caches hold the longest matching
+        chain wins — unless its load skew trips the escape hatch
+        (_PREFIX_MAX_SKEW), in which case plain power-of-two resumes.
+        Tie-breaking samples from the router's seeded RNG so choice
+        sequences replay deterministically under the chaos harness."""
         while True:
             self._refresh()
             with self._lock:
@@ -434,7 +484,17 @@ class _Router:
                 if replicas:
                     if len(replicas) == 1:
                         return replicas[0]
-                    a, b = random.sample(replicas, 2)
+                    if prefix_digests:
+                        choice = self._prefix_choice_locked(
+                            replicas, prefix_digests
+                        )
+                        if choice is not None:
+                            self._m_prefix_hits.inc(
+                                tags={"app": self.app_name,
+                                      "deployment": self.deployment_name}
+                            )
+                            return choice
+                    a, b = self._rng.sample(replicas, 2)
                     la = self._inflight.get(a._actor_id.binary(), 0)
                     lb = self._inflight.get(b._actor_id.binary(), 0)
                     return a if la <= lb else b
@@ -444,6 +504,85 @@ class _Router:
                     f"{self.deployment_name}"
                 )
             time.sleep(0.1)
+
+    def _prefix_choice_locked(self, replicas: list,
+                              prefix_digests: tuple):
+        """Score each candidate by how many LEADING digests of the
+        prompt's chain its advertised summary holds; -> the best replica,
+        or None to fall back to power-of-two (no replica matches, or the
+        winner is too loaded relative to the least-loaded candidate).
+        Ties prefer the less-loaded replica, then table order — fully
+        deterministic given one routing table."""
+        best = None
+        best_match = 0
+        best_load = 0
+        min_load: int | None = None
+        for r in replicas:
+            aid = r._actor_id.binary()
+            load = self._inflight.get(aid, 0)
+            if min_load is None or load < min_load:
+                min_load = load
+            resident = self._prefix_summaries.get(aid)
+            if not resident:
+                continue
+            match = 0
+            for d in prefix_digests:
+                if d not in resident:
+                    break
+                match += 1
+            if match > best_match or (
+                match == best_match and match > 0 and load < best_load
+            ):
+                best, best_match, best_load = r, match, load
+        if best is None or best_match == 0:
+            return None
+        if best_load - (min_load or 0) > _PREFIX_MAX_SKEW:
+            return None  # escape hatch: hot prefix must not hotspot
+        return best
+
+    def _prompt_digests(self, payload: dict) -> tuple | None:
+        """Leading chain digests (hex) of a fresh ``__call__`` prompt,
+        computed in the SAME digest space as the replicas' block chains
+        (kv_cache._block_key over encode_text-style tokens). Returns
+        None whenever the prefix path should not apply: routing disabled,
+        no summaries advertised yet, a failover resume (``prior_tokens``
+        payloads keep today's dispatch path), or a payload the router
+        cannot tokenize."""
+        if payload.get("prior_tokens"):
+            return None
+        with self._lock:
+            if not self._prefix_routing:
+                return None
+            bs = self._prefix_block_size
+            vocab = self._prefix_vocab_size
+            have_summaries = any(self._prefix_summaries.values())
+        if not bs or not have_summaries:
+            return None
+        prompt = payload.get("prompt")
+        try:
+            if isinstance(prompt, str):
+                if not vocab:
+                    return None
+                # mirror serve.llm.api.encode_text byte-for-byte
+                tokens = [b % vocab for b in prompt.encode("utf-8")]
+            else:
+                tokens = list(prompt or ())
+            if len(tokens) < bs:
+                return None
+            from ray_tpu.serve.llm.kv_cache import _block_key
+
+            digest = b""
+            out = []
+            for i in range(min(len(tokens) // bs, _PREFIX_MATCH_BLOCKS)):
+                digest = _block_key(digest, tokens[i * bs:(i + 1) * bs])
+                out.append(digest.hex())
+            return tuple(out) or None
+        except Exception as e:  # noqa: BLE001 — unroutable payload shape
+            logger.debug(
+                "prefix digests skipped for %s/%s: %r",
+                self.app_name, self.deployment_name, e,
+            )
+            return None
 
     # -- call paths --
 
@@ -485,7 +624,16 @@ class _Router:
                 "saturated (queue backlog + KV pressure on every replica); "
                 "shedding at admission — retry later"
             )
-        replica = self._pick_replica(time.monotonic() + 30, exclude)
+        # prefix-aware placement applies to fresh generation dispatches
+        # only: __call__ with a dict payload and no prior_tokens (resumes
+        # and control methods keep the plain path — but still compose
+        # with ``exclude`` inside _pick_replica)
+        prefix_digests = None
+        if method_name == "__call__" and args and isinstance(args[0], dict):
+            prefix_digests = self._prompt_digests(args[0])
+        replica = self._pick_replica(
+            time.monotonic() + 30, exclude, prefix_digests
+        )
         aid = replica._actor_id.binary()
         # when the caller carries a trace, open a dispatch span so the
         # replica task (whose trace_ctx is captured at .remote() time)

@@ -167,6 +167,11 @@ class EngineConfig:
     max_waiting_blocks: int | None = None  # worst-case block budget queued
     step_timeout_s: float | None = None    # watchdog: wedged-step ceiling
     prefix_caching: bool = True   # map prompts onto resident KV blocks
+    # Host-memory KV tier capacity in bytes (0 disables). When set, LRU
+    # eviction demotes full prefix blocks into a host arena instead of
+    # discarding them, and prefix hits promote them back through the
+    # executor's fused land_blocks scatter — see kv_cache.HostKVTier.
+    host_cache_bytes: int = 0
     # Prefill one prompt in slices of at most this many tokens, alternating
     # with decode steps. None -> the whole uncached suffix in one call (the
     # monolithic PR 1 behavior for cold prompts).
@@ -382,6 +387,7 @@ class LLMEngine:
                 num_blocks=cfg.num_blocks,
                 block_size=cfg.block_size,
                 dtype=model_cfg.dtype,
+                host_cache_bytes=cfg.host_cache_bytes,
             )
         )
         # the ModelExecutor seam (executor.py): the engine schedules on
@@ -391,6 +397,11 @@ class LLMEngine:
         self.executor = build_executor(
             cfg, model_cfg, self.cache, params=params
         )
+        # Host-tier demote capture goes through the executor's existing
+        # bulk-export funnel (the allowlisted _host_blocks path) — the
+        # cache itself never touches the device.
+        if cfg.host_cache_bytes > 0:
+            self.cache.demote_fn = self.executor.export_blocks
         # speculative decoding: host-side drafter + acceptance accounting
         if cfg.speculative_k < 0:
             raise ValueError("speculative_k must be >= 0")
@@ -455,7 +466,10 @@ class LLMEngine:
         self._sync_bytes_total = 0
         self._last_sync: dict | None = None  # merged into flight records
         # last cache-stat values already exported to the monotonic counters
-        self._exported = {"hit": 0, "evict": 0, "cow": 0, "prefill": 0}
+        self._exported = {
+            "hit": 0, "evict": 0, "cow": 0, "prefill": 0,
+            "demote": 0, "promote": 0,
+        }
         # ---- observability plane (ISSUE 4) ----
         self._flight = obs.FlightRecorder(cfg.flight_recorder_steps)
         # finished-request timelines, newest-last, bounded
@@ -465,7 +479,7 @@ class LLMEngine:
         self._step_admitted = 0
         self._step_expired = 0
         # cache-stat values as of the previous flight record (deltas)
-        self._flight_prev = {"cow": 0, "evict": 0}
+        self._flight_prev = {"cow": 0, "evict": 0, "demote": 0, "promote": 0}
         self._dumped = False  # one post-mortem dump per engine
         # ---- autoscaling signal windows (ISSUE 10) ----
         # Bounded sample/event rings feeding autoscaling_snapshot(): the
@@ -534,6 +548,20 @@ class LLMEngine:
             "llm_spec_committed_tokens",
             "Tokens committed by speculative verify steps (accepted + "
             "corrected/bonus)",
+        )
+        self._m_demoted = metrics.counter(
+            "llm_kv_demoted_blocks",
+            "KV blocks demoted from the device pool into the host cache "
+            "tier on LRU eviction",
+        )
+        self._m_promoted = metrics.counter(
+            "llm_kv_promoted_blocks",
+            "Host-tier KV blocks promoted back into the device pool on "
+            "prefix hits",
+        )
+        self._m_host_blocks = metrics.gauge(
+            "llm_host_cache_blocks",
+            "Demoted KV blocks resident in the host cache tier",
         )
         self._m_ttft = obs.ttft_histogram()
         self._m_tpot = obs.tpot_histogram()
@@ -823,6 +851,12 @@ class LLMEngine:
                 "prefix_hit_blocks": cs.prefix_hit_blocks,
                 "prefix_cached_blocks": self.cache.cached_blocks,
                 "prefix_evicted_blocks": cs.prefix_evicted_blocks,
+                "host_cache_blocks": (
+                    0 if self.cache.host_tier is None
+                    else self.cache.host_tier.blocks
+                ),
+                "kv_demoted_blocks": cs.demoted_blocks,
+                "kv_promoted_blocks": cs.promoted_blocks,
                 "cow_blocks": cs.cow_copies,
                 "prefill_tokens_total": computed,
                 "prefix_hit_rate": hit / max(1, hit + computed),
@@ -908,6 +942,12 @@ class LLMEngine:
         # (they are evictable on demand).
         claimable = max(0, cache.available_blocks - snap["reserved_blocks"])
         pressure = min(1.0, max(0.0, 1.0 - claimable / usable))
+        # Two-tier pressure: a pressured device pool backed by a warm
+        # host tier is cheaper to miss into than one without (misses
+        # promote instead of recomputing), so the host-resident block
+        # count discounts the device pressure, bounded at zero. With the
+        # tier disabled this equals kv_pool_pressure exactly.
+        pressure_two_tier = max(0.0, pressure - snap["host_blocks"] / usable)
         out = {
             "ts_wall": obs.wall(),
             "clock": now,
@@ -922,6 +962,16 @@ class LLMEngine:
             "kv_cached_blocks": snap["cached_blocks"],
             "kv_quarantined_blocks": snap["quarantined_blocks"],
             "kv_pool_pressure": round(pressure, 4),
+            "kv_host_cached_blocks": snap["host_blocks"],
+            "kv_host_cache_bytes": snap["host_bytes"],
+            "kv_pressure_two_tier": round(pressure_two_tier, 4),
+            # Prefix-routing piggyback: the bounded digest summary rides
+            # the snapshot the controller already polls, plus the two
+            # constants the router needs to hash raw prompts into the
+            # same chain-digest space (encode_text is ``byte % vocab``).
+            "prefix_digests": cache.prefix_digest_summary(),
+            "block_size": cache.cfg.block_size,
+            "vocab_size": self.model_cfg.vocab_size,
             "deadline_miss_rate": round(
                 _window_rate(self._deadline_clocks, now), 4
             ),
@@ -1192,6 +1242,26 @@ class LLMEngine:
             return
         self.executor.copy_blocks(pairs)
 
+    def _apply_promotions_locked(self) -> None:
+        """Land host-tier promotions staged by admission as ONE fused
+        ``land_blocks`` scatter (the handoff-landing path — host->device
+        only, no new sync point, no new compile kind). Must run at the
+        TOP of a dispatch window, before ``prepare_write``/
+        ``_apply_copies_locked``: a COW fork of a promoted block must
+        clone landed content, and a capacity eviction in the same window
+        must see the landing acked before it may demote-export."""
+        staged = self.cache.take_pending_promotions()
+        if not staged:
+            return
+        chaos.fire("llm.kv.promote", blocks=len(staged))
+        ids = [b for b, _, _ in staged]
+        self.executor.land_blocks(
+            ids,
+            np.stack([k for _, k, _ in staged], axis=1),
+            np.stack([v for _, _, v in staged], axis=1),
+        )
+        self.cache.promotions_landed(ids)
+
     def _prefill_chunk_locked(self) -> None:
         """Run ONE prefill call for up to ``max_prefill_batch`` admitted
         requests: each contributes its next chunk (the whole uncached
@@ -1203,6 +1273,9 @@ class LLMEngine:
         chaos.fire("engine.prefill", batch=len(batch))
         t0 = obs.clock()
         t0_wall = obs.wall()
+        # staged host-tier promotions land before capacity/COW work so a
+        # same-window eviction or fork of a promoted block is safe
+        self._apply_promotions_locked()
         bs = self.cfg.block_size
         cap = self.cfg.prefill_chunk_tokens
         ns = []
@@ -1366,6 +1439,7 @@ class LLMEngine:
                 "decode", t0_wall, dt, batch=0, tokens=emitted,
             )
             return
+        self._apply_promotions_locked()
         pairs: list[tuple[int, int]] = []
         for r in batch:
             # effective length includes the in-flight token: its K/V row
@@ -1507,6 +1581,7 @@ class LLMEngine:
         bs = self.cfg.block_size
         W = self.cfg.speculative_k + 1
         draft_lens = [len(p) for p in proposals]
+        self._apply_promotions_locked()
         pairs: list[tuple[int, int]] = []
         for r, dl in zip(batch, draft_lens):
             # the window writes K/V at positions total_len-1 ..
@@ -1754,11 +1829,16 @@ class LLMEngine:
             ("evict", cs.prefix_evicted_blocks, self._m_evicted),
             ("cow", cs.cow_copies, self._m_cow),
             ("prefill", self._prefill_tokens_total, self._m_prefill_tokens),
+            ("demote", cs.demoted_blocks, self._m_demoted),
+            ("promote", cs.promoted_blocks, self._m_promoted),
         ):
             delta = value - self._exported[key]
             if delta > 0:
                 counter.inc(delta)
                 self._exported[key] = value
+        self._m_host_blocks.set(
+            0 if self.cache.host_tier is None else self.cache.host_tier.blocks
+        )
 
     # ---------------- observability (ISSUE 4) ----------------
 
@@ -1874,6 +1954,18 @@ class LLMEngine:
             "waiting": len(self._waiting),
             "prefilling": len(self._prefilling),
             "running": len(self._running),
+            # host-tier view: absolute occupancy + per-step spill churn,
+            # so a post-mortem dump shows BOTH cache tiers per step
+            "host_blocks": (
+                0 if self.cache.host_tier is None
+                else self.cache.host_tier.blocks
+            ),
+            "host_bytes": (
+                0 if self.cache.host_tier is None
+                else self.cache.host_tier.nbytes
+            ),
+            "demotions": cs.demoted_blocks - self._flight_prev["demote"],
+            "promotions": cs.promoted_blocks - self._flight_prev["promote"],
         }
         rec.update(fields)
         if self._last_sync is not None:
@@ -1882,6 +1974,8 @@ class LLMEngine:
             self._last_sync = None
         self._flight_prev["cow"] = cs.cow_copies
         self._flight_prev["evict"] = cs.prefix_evicted_blocks
+        self._flight_prev["demote"] = cs.demoted_blocks
+        self._flight_prev["promote"] = cs.promoted_blocks
         self._flight.record(rec)
 
     def _on_new_signature(self, sig: tuple) -> None:

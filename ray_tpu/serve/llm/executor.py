@@ -211,13 +211,18 @@ class ModelExecutor:
         self, block_ids: list[int]
     ) -> tuple[np.ndarray, np.ndarray]:
         """Materialize the given physical blocks host-side for a
-        disaggregated handoff: returns (k, v) each
-        [n_layer, len(block_ids), block_size, H_kv, hd] numpy, in the
-        given order. The gather pads to a pow2 bucket with block 0 so
-        the traced shape set stays closed (same discipline as
+        disaggregated handoff, or as the host-tier demote capture
+        (``PagedKVCache.demote_fn`` — the engine installs this method, so
+        spill traffic flows through the same allowlisted ``_host_blocks``
+        funnel instead of growing a new device->host sync point): returns
+        (k, v) each [n_layer, len(block_ids), block_size, H_kv, hd]
+        numpy, in the given order. The gather pads to a pow2 bucket with
+        block 0 so the traced shape set stays closed (same discipline as
         ``copy_blocks``); padding rows are sliced off host-side. On a
         mesh the gather output is unsharded along heads by the transfer
-        itself — the wire format is mesh-agnostic."""
+        itself — the wire format is mesh-agnostic, which is also what
+        makes a host-tier entry demoted under tp=1 byte-identical to one
+        demoted under tp=4."""
         if not block_ids:
             n_layer = self.cache.k.shape[0]
             shape = (n_layer, 0) + tuple(self.cache.k.shape[2:])
@@ -235,10 +240,16 @@ class ModelExecutor:
         self, block_ids: list[int], k_new: np.ndarray, v_new: np.ndarray
     ) -> None:
         """Scatter externally-produced KV blocks (a fetched handoff
-        payload) into this executor's pool at ``block_ids``, all layers
-        fused (ops/kv_cache.land_blocks). Pads the id list to a pow2
-        bucket targeting garbage block 0 with zero payload rows, so the
-        jitted shape set stays closed."""
+        payload, or a batch of host-tier promotions drained by
+        ``engine._apply_promotions_locked``) into this executor's pool at
+        ``block_ids``, all layers fused (ops/kv_cache.land_blocks). Pads
+        the id list to a pow2 bucket targeting garbage block 0 with zero
+        payload rows, so the jitted shape set stays closed — promotion
+        traffic therefore adds no compile kinds; host->device staging is
+        ONE batched transfer per call. On a mesh the committed inputs
+        re-shard along kv heads automatically (same GSPMD inference as
+        every other call), so both executors serve promotions through
+        this one method."""
         if not block_ids:
             return
         from ray_tpu.ops.kv_cache import land_blocks

@@ -39,15 +39,33 @@ clone is ``ops.kv_cache.copy_blocks``). Reservations draw uniformly from
 hits, appends and COW copies, so the no-mid-flight-failure invariant is
 unchanged; ``release_all`` also drops the content-addressed set, keeping
 engine create/shutdown cycles leak-free.
+
+Host-memory tier (``host_cache_bytes > 0``): LRU eviction DEMOTES a full
+prefix block into a pinned host-side arena instead of discarding it —
+the plasma spill model from the Ray object store, applied to KV. Each
+arena entry is one RTKV v1 per-block record (kv_transfer.py): chain
+digest + content digest + the raw k||v payload, so promotion re-verifies
+bytes before they ever touch the device pool. ``peek_prefix`` /
+``assign_prefix`` consult the arena after a device miss and PROMOTE hits
+back: the block is claimed like an append (same reservation accounting)
+and its payload is queued; the engine drains the queue as ONE fused
+``land_blocks`` scatter per step through the executor seam — no new sync
+points, no new compile kinds. This module stays device-free: the
+device->host capture at demote time goes through ``demote_fn`` (the
+engine installs ``executor.export_blocks``, the allowlisted
+``_host_blocks`` funnel), and promotion payloads are plain numpy.
 """
 from __future__ import annotations
 
 import hashlib
+import logging
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any
 
 import numpy as np
+
+logger = logging.getLogger("ray_tpu.serve.llm")
 
 
 def _block_key(prev: bytes, block_tokens) -> bytes:
@@ -69,6 +87,11 @@ class KVCacheConfig:
     num_blocks: int = 64
     block_size: int = 16
     dtype: Any = None  # jnp dtype; None -> bfloat16
+    # Host-memory cache tier capacity. 0 disables the tier: LRU eviction
+    # discards content exactly as before. When > 0, evicted prefix blocks
+    # demote into a host arena of at most this many bytes (RTKV wire
+    # size, so header + digests count against the cap).
+    host_cache_bytes: int = 0
 
     @property
     def usable_blocks(self) -> int:
@@ -88,7 +111,100 @@ class CacheStats:
     prefix_evicted_blocks: int = 0
     cow_copies: int = 0
     adopted_blocks: int = 0  # handoff blocks landed from another replica
+    demoted_blocks: int = 0      # device blocks spilled into the host tier
+    promoted_blocks: int = 0     # host-tier hits claimed back into the pool
+    host_evicted_blocks: int = 0  # arena entries dropped to fit the byte cap
+    promotion_drops: int = 0     # queued promotions invalidated before landing
+    demote_drops: int = 0        # demote captures that failed (content lost)
+    host_corrupt_drops: int = 0  # arena entries failing RTKV verification
     tables: dict = field(default_factory=dict)
+
+
+class HostKVTier:
+    """Pinned host-memory arena for demoted prefix blocks.
+
+    Pure container: an LRU ``OrderedDict`` keyed by chain digest whose
+    values are RTKV v1 wire payloads (kv_transfer.pack_blocks with exactly
+    one record), byte-capacity-capped. Packing on the way in and
+    unpacking on the way out reuses the transfer module's content
+    addressing verbatim, so a bit flipped while a block sat in host RAM
+    fails the content digest at promote time instead of corrupting the
+    device pool. No device access, no policy — PagedKVCache owns when to
+    demote, promote and verify.
+    """
+
+    def __init__(self, capacity_bytes: int, layout) -> None:
+        self.capacity_bytes = int(capacity_bytes)
+        self.layout = layout  # kv_transfer.KVLayout of the device pool
+        self._wire: OrderedDict[bytes, bytes] = OrderedDict()
+        self._nbytes = 0
+
+    def __contains__(self, digest: bytes) -> bool:
+        return digest in self._wire
+
+    @property
+    def blocks(self) -> int:
+        return len(self._wire)
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def digests(self):
+        """Resident chain digests, most-recently-used first."""
+        return reversed(self._wire)
+
+    def touch(self, digest: bytes) -> None:
+        if digest in self._wire:
+            self._wire.move_to_end(digest)
+
+    def put(self, digest: bytes, k_block, v_block) -> tuple[bool, int]:
+        """Store one demoted block; -> (stored, arena entries evicted to
+        make room). A payload larger than the whole cap is refused; a
+        digest already resident is refreshed, not re-packed."""
+        from ray_tpu.serve.llm import kv_transfer
+
+        if digest in self._wire:
+            self._wire.move_to_end(digest)
+            return True, 0
+        wire = kv_transfer.pack_blocks(
+            self.layout, [(digest, k_block, v_block)], prefix_tokens=0
+        )
+        if len(wire) > self.capacity_bytes:
+            return False, 0
+        evicted = 0
+        while self._nbytes + len(wire) > self.capacity_bytes:
+            _, old = self._wire.popitem(last=False)  # oldest first
+            self._nbytes -= len(old)
+            evicted += 1
+        self._wire[digest] = wire
+        self._nbytes += len(wire)
+        return True, evicted
+
+    def get(self, digest: bytes):
+        """Unpack + verify one entry; -> (k_block, v_block) numpy arrays.
+        Raises kv_transfer.KVTransferError on any corruption — the caller
+        must treat that as a miss and ``discard`` the entry."""
+        from ray_tpu.serve.llm import kv_transfer
+
+        wire = self._wire[digest]
+        _, _, records = kv_transfer.unpack_blocks(wire)
+        chain, k_block, v_block = records[0]
+        if chain != digest:
+            raise kv_transfer.KVTransferError(
+                "host-tier entry chain digest mismatch"
+            )
+        self._wire.move_to_end(digest)
+        return k_block, v_block
+
+    def discard(self, digest: bytes) -> None:
+        wire = self._wire.pop(digest, None)
+        if wire is not None:
+            self._nbytes -= len(wire)
+
+    def clear(self) -> None:
+        self._wire.clear()
+        self._nbytes = 0
 
 
 class PagedKVCache:
@@ -130,6 +246,37 @@ class PagedKVCache:
         # bumped whenever a sequence's table CONTENT changes (append / COW /
         # prefix mapping) — lets the engine cache host-side numpy tables
         self._versions: dict[Any, int] = {}
+        # --- host tier (plasma-style spill of evicted prefix blocks) ---
+        # The engine installs the device->host capture funnel after it
+        # builds the executor (``cache.demote_fn = executor.export_blocks``);
+        # until then — and whenever the tier is disabled — eviction
+        # discards content exactly as before.
+        self.demote_fn = None
+        if cfg.host_cache_bytes > 0:
+            from ray_tpu.serve.llm import kv_transfer
+
+            self.host_tier = HostKVTier(
+                cfg.host_cache_bytes,
+                kv_transfer.KVLayout(
+                    n_layer=cfg.n_layer,
+                    block_size=cfg.block_size,
+                    n_kv_head=cfg.n_kv_head,
+                    head_dim=cfg.head_dim,
+                    dtype=self.k.dtype.name,
+                ),
+            )
+        else:
+            self.host_tier = None
+        # Promotions staged by assign_prefix, drained by the engine as ONE
+        # fused land_blocks scatter at the top of the next dispatch window:
+        # (chain digest, block id, k payload, v payload).
+        self._pending_promotions: list[tuple[bytes, int, Any, Any]] = []
+        # Blocks claimed for promotion whose payload has NOT landed on
+        # device yet. Such a block must never be demote-exported (the
+        # device content is still garbage); its bytes are safe — the host
+        # tier keeps the entry through promotion precisely so eviction
+        # before landing loses nothing.
+        self._unlanded: set[int] = set()
         self.stats = CacheStats()
 
     # ---------------- reservation (admission control) ----------------
@@ -174,7 +321,8 @@ class PagedKVCache:
 
     def _take_block(self, *, reserved: bool) -> int:
         """Claim one writable block: from the free list, else by evicting
-        the LRU-oldest content-addressed block (its hash entry dies)."""
+        the LRU-oldest content-addressed block (its hash entry dies; with
+        the host tier enabled its content demotes instead of dying)."""
         if self._free:
             b = self._free.pop()
         elif self._lru:
@@ -182,6 +330,7 @@ class PagedKVCache:
             h = self._block_hash.pop(b)
             del self._hash_to_block[h]
             self.stats.prefix_evicted_blocks += 1
+            self._demote_evicted(h, b)
         else:
             raise RuntimeError(
                 "KV block pool exhausted — reservation accounting bug"
@@ -267,20 +416,34 @@ class PagedKVCache:
         self._hash_to_block.clear()
         self._block_hash.clear()
         self._reserved = 0
+        # Host tier dies with the device cache: a queued promotion landing
+        # after release could scribble on a re-allocated block, and a
+        # shutdown that kept arena bytes would leak across engine
+        # create/shutdown cycles.
+        self._pending_promotions.clear()
+        self._unlanded.clear()
+        if self.host_tier is not None:
+            self.host_tier.clear()
         return returned
 
     # ---------------- prefix cache ----------------
 
     def peek_prefix(self, tokens) -> int:
-        """Number of LEADING full blocks of ``tokens`` currently resident
-        (referenced or cached) — a pure lookup, no state change. The
-        engine uses it to size the reservation before committing."""
+        """Number of LEADING full blocks of ``tokens`` currently servable
+        without recompute — resident on device (referenced or cached) OR
+        demoted into the host tier. A pure lookup, no state change. The
+        engine uses it to size the reservation before committing; a host
+        hit that later fails RTKV verification in ``assign_prefix`` just
+        shortens the assigned prefix, which the over-sized reservation
+        already covers."""
         digest = b""
         bs = self.cfg.block_size
         hits = 0
         for i in range(len(tokens) // bs):
             digest = _block_key(digest, tokens[i * bs:(i + 1) * bs])
-            if digest not in self._hash_to_block:
+            if digest not in self._hash_to_block and not (
+                self.host_tier is not None and digest in self.host_tier
+            ):
                 break
             hits += 1
         return hits
@@ -352,15 +515,31 @@ class PagedKVCache:
         for i in range(limit):
             nxt = _block_key(digest, tokens[i * bs:(i + 1) * bs])
             b = self._hash_to_block.get(nxt)
-            if b is None:
-                break
-            if b in self._lru:  # resurrect: cached -> referenced
-                del self._lru[b]
-                self._ref[b] = 1
+            if b is not None:
+                if b in self._lru:  # resurrect: cached -> referenced
+                    del self._lru[b]
+                    self._ref[b] = 1
+                else:
+                    self._ref[b] += 1
+                self._reserved -= 1
             else:
-                self._ref[b] += 1
+                # Device miss — promote from the host tier. The block is
+                # claimed exactly like an append (one reservation unit),
+                # content-addressed immediately, and its payload staged
+                # for the engine's next batched land_blocks scatter. The
+                # arena keeps its entry: that provenance is what makes
+                # the block safe to evict again before landing.
+                payload = self._host_lookup(nxt)
+                if payload is None:
+                    break
+                b = self._take_block(reserved=True)
+                self._ref[b] = 1
+                self._hash_to_block[nxt] = b
+                self._block_hash[b] = nxt
+                self._pending_promotions.append((nxt, b, payload[0], payload[1]))
+                self._unlanded.add(b)
+                self.stats.promoted_blocks += 1
             table.append(b)
-            self._reserved -= 1
             digest = nxt
             hits += 1
         if hits:
@@ -430,6 +609,132 @@ class PagedKVCache:
             )
         return pairs
 
+    # ---------------- host tier (demote / promote) ----------------
+
+    def _demote_evicted(self, digest: bytes, block: int) -> None:
+        """Spill one LRU-evicted prefix block into the host tier (no-op
+        with the tier disabled or no ``demote_fn`` installed). Best-effort
+        by design: a failed capture loses a CACHE entry, never
+        correctness, so failures are counted + logged, not raised. A
+        block whose promotion payload has not landed yet is never
+        exported — its device bytes are still garbage; the arena kept the
+        real content through the promotion, so nothing is lost unless the
+        arena has meanwhile evicted that entry too."""
+        tier = self.host_tier
+        if tier is None:
+            return
+        if block in self._unlanded:
+            # the queued landing is now stale (its hash mapping just
+            # died); the drain filter drops it by digest mismatch
+            self._unlanded.discard(block)
+            if digest in tier:
+                tier.touch(digest)
+            else:
+                self.stats.demote_drops += 1
+                logger.warning(
+                    "unlanded promoted block %d evicted after its arena "
+                    "entry %s was dropped — content lost",
+                    block, digest.hex(),
+                )
+            return
+        if digest in tier:
+            tier.touch(digest)  # already backed: refresh recency, skip export
+            return
+        if self.demote_fn is None:
+            return
+        from ray_tpu._private import chaos
+
+        try:
+            chaos.fire("llm.kv.demote", block=block)
+            k, v = self.demote_fn([block])
+            stored, evicted = tier.put(digest, k[:, 0], v[:, 0])
+            if stored:
+                self.stats.demoted_blocks += 1
+                self.stats.host_evicted_blocks += evicted
+            else:
+                self.stats.demote_drops += 1
+                logger.warning(
+                    "host tier refused demoted block %d (payload exceeds "
+                    "host_cache_bytes=%d)", block, tier.capacity_bytes,
+                )
+        except Exception as exc:
+            self.stats.demote_drops += 1
+            logger.warning(
+                "host-tier demotion of block %d failed: %r", block, exc
+            )
+
+    def _host_lookup(self, digest: bytes):
+        """Fetch + verify one host-tier entry; -> (k, v) numpy blocks or
+        None. Verification failure (bit rot in host RAM, a truncated
+        write) is a miss: the entry is dropped, counted and logged —
+        corrupt bytes must never land in the device pool."""
+        tier = self.host_tier
+        if tier is None or digest not in tier:
+            return None
+        try:
+            return tier.get(digest)
+        except Exception as exc:
+            tier.discard(digest)
+            self.stats.host_corrupt_drops += 1
+            logger.warning(
+                "host-tier entry %s failed verification, dropped: %r",
+                digest.hex(), exc,
+            )
+            return None
+
+    def take_pending_promotions(self) -> list[tuple[int, Any, Any]]:
+        """Drain staged host->device promotions for the engine to land as
+        ONE fused ``land_blocks`` scatter; -> (block id, k, v) records.
+        Exactly-once: each staged record is returned at most once, and a
+        record whose block lost its content address before landing (its
+        sequence was cancelled and a racing admission evicted the block)
+        is dropped here — the arena still holds the bytes, so the drop
+        costs a future re-promotion, not content. Callers MUST follow a
+        successful scatter with ``promotions_landed``."""
+        if not self._pending_promotions:
+            return []
+        staged, self._pending_promotions = self._pending_promotions, []
+        out: list[tuple[int, Any, Any]] = []
+        for digest, b, k_block, v_block in staged:
+            if self._block_hash.get(b) != digest:
+                self._unlanded.discard(b)
+                self.stats.promotion_drops += 1
+                logger.debug(
+                    "promotion of block %d dropped: evicted before landing", b
+                )
+                continue
+            out.append((b, k_block, v_block))
+        return out
+
+    def promotions_landed(self, block_ids) -> None:
+        """Ack that the payloads for ``block_ids`` (returned by
+        ``take_pending_promotions``) are on device — they become ordinary
+        resident prefix blocks, eligible for demote-export again."""
+        for b in block_ids:
+            self._unlanded.discard(b)
+
+    def prefix_digest_summary(self, limit: int = 32) -> list[str]:
+        """Bounded routing-key summary for the fleet router: hex chain
+        digests of prefix blocks this cache can serve without recompute —
+        device-resident entries newest-registered first, then host-tier
+        entries most-recently-used first. Piggybacked on the autoscaling
+        snapshot, so router staleness is bounded by the controller's poll
+        period."""
+        out: list[str] = []
+        seen: set[bytes] = set()
+        for digest in reversed(self._hash_to_block):
+            if len(out) >= limit:
+                return out
+            out.append(digest.hex())
+            seen.add(digest)
+        if self.host_tier is not None:
+            for digest in self.host_tier.digests():
+                if len(out) >= limit:
+                    break
+                if digest not in seen:
+                    out.append(digest.hex())
+        return out
+
     # ---------------- views ----------------
 
     @property
@@ -488,6 +793,14 @@ class PagedKVCache:
             "prefix_evicted_blocks": s.prefix_evicted_blocks,
             "cow_copies": s.cow_copies,
             "adopted_blocks": s.adopted_blocks,
+            "host_blocks": 0 if self.host_tier is None else self.host_tier.blocks,
+            "host_bytes": 0 if self.host_tier is None else self.host_tier.nbytes,
+            "demotions": s.demoted_blocks,
+            "promotions": s.promoted_blocks,
+            "host_evicted_blocks": s.host_evicted_blocks,
+            "promotion_drops": s.promotion_drops,
+            "demote_drops": s.demote_drops,
+            "host_corrupt_drops": s.host_corrupt_drops,
         }
 
     def num_allocated(self, seq_id) -> int:

@@ -138,8 +138,19 @@ def test_device_values_cross_host_only_in_host_tokens():
     ``_host_tokens`` funnel (``executor.sync_verify``). Allowlist:
     ``_host_tokens`` (THE sync point), ``_host_blocks`` (the
     disaggregated-handoff KV export — an explicit bulk pull OFF the
-    emit path, ISSUE 11), and kv_cache's ``_block_key`` (hashes
-    host-side Python int lists — never touches a device value)."""
+    emit path, ISSUE 11 — and, since ISSUE 15, the host-tier demote
+    capture), and kv_cache's ``_block_key`` (hashes host-side Python
+    int lists — never touches a device value).
+
+    The host KV tier (ISSUE 15) is additionally pinned to the executor
+    funnel by construction: serve/llm code outside executor.py/engine.py
+    must never call the executor's device-boundary methods
+    (``export_blocks``/``land_blocks``/``copy_blocks``/``sync_tokens``/
+    ``sync_verify``) directly. kv_cache.py stages demotes through the
+    engine-installed ``demote_fn`` indirection and queues promotions
+    for the engine's ONE batched ``land_blocks`` drain per step — a
+    direct call from the cache (or the drafter, or api.py) would be a
+    new device sync point outside the dispatch funnel."""
     import ast
     import pathlib
 
@@ -205,6 +216,31 @@ def test_device_values_cross_host_only_in_host_tokens():
         f"device->host sync outside executor._host_tokens: {offenders}"
     )
 
+    # second pass: the executor's device-boundary methods are callable
+    # only from the funnel modules themselves (executor.py defines them,
+    # engine.py drives them under the dispatch lock)
+    funnel_methods = {
+        "export_blocks", "land_blocks", "copy_blocks",
+        "sync_tokens", "sync_verify",
+    }
+    funnel_files = {"executor.py", "engine.py"}
+    boundary_offenders = []
+    for path in targets:
+        if path.name in funnel_files:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in funnel_methods):
+                boundary_offenders.append(
+                    f"{path.relative_to(root)}:{node.lineno} "
+                    f"({node.func.attr})")
+    assert not boundary_offenders, (
+        "executor device-boundary methods called outside the "
+        f"executor/engine funnel: {boundary_offenders}"
+    )
+
 
 def test_handoff_retry_paths_never_swallow_silently():
     """Failure-semantics lint (ISSUE 11): the KV-handoff state machine is
@@ -222,7 +258,16 @@ def test_handoff_retry_paths_never_swallow_silently():
     checkpoint paths (ISSUE 12) are held to the same bar: every typed
     fallback there (checkpoint write failed -> retry, replica dead ->
     drop, orphan kill raced) changes cluster state, so a handler that
-    neither raises nor logs turns a recovery decision invisible."""
+    neither raises nor logs turns a recovery decision invisible.
+
+    The host KV tier's demote/promote paths (ISSUE 15) join the scope:
+    a failed demote is a lost cache entry (counted, never a correctness
+    event) and a corrupt host record is dropped and re-filled by
+    recompute — both are only safe because the drop is observable. The
+    router's prompt-digest computation (handle.py ``_prompt_digests``)
+    degrades to plain load balancing on any error, which likewise must
+    leave a trace or prefix routing can silently stop working
+    fleet-wide."""
     import ast
     import pathlib
 
@@ -238,6 +283,10 @@ def test_handoff_retry_paths_never_swallow_silently():
         }),
         root / "ray_tpu" / "serve" / "handle.py": frozenset({
             "__next__", "resume_backoff_s", "_refresh",
+            "_prompt_digests",
+        }),
+        root / "ray_tpu" / "serve" / "llm" / "kv_cache.py": frozenset({
+            "_demote_evicted", "_host_lookup",
         }),
         root / "ray_tpu" / "serve" / "controller.py": frozenset({
             "_recover", "_checkpoint", "_adopt_replica",
