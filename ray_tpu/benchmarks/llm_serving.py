@@ -84,7 +84,29 @@ Reported (one JSON line, merged into bench.py's aux results under
                               ``_long`` — decode TPOT per prompt class,
                               the number disaggregated prefill
                               (``run_load_bench(prefill_replicas=1)``)
-                              is judged on
+                              is judged on; a LOAD_JSON_FRACTION
+                              minority of requests runs grammar-
+                              constrained (``response_format="json"``)
+                              and reports ``llm_load_json_requests`` /
+                              ``llm_load_json_valid`` (every constrained
+                              stream replays through its DFA, through
+                              the kill included)
+
+- ``llm_structured_tokens_per_sec`` / ``llm_structured_tpot_overhead_pct``
+                              grammar-constrained decoding
+                              (``run_structured_bench``): a small batch
+                              of JSON-mode streams vs the identical
+                              unconstrained workload through fresh
+                              engines on the shared jit cache — decode
+                              throughput with the allow-mask staged,
+                              TPOT overhead vs the baseline (the mask is
+                              data, so the target is single-digit pct),
+                              plus ``llm_structured_valid`` (every
+                              constrained stream replays through its
+                              DFA and completed streams json-parse) and
+                              ``llm_grammar_compile_cold_ms`` (cold
+                              grammar->DFA compile, the cost the LRU
+                              cache amortises away)
 
 - ``llm_fleet_prefix_hit_rate`` / ``llm_fleet_prefix_ttft_p99_ms``
                               the fleet KV bench (``run_fleet_prefix_bench``):
@@ -138,6 +160,10 @@ PAGED_ATTN_ITERS = 20
 # the n-gram drafter locks onto the repeating motif within the run
 SPEC_K = 4
 SPEC_NEW_TOKENS = 48
+# structured-output phase: JSON-mode streams vs the same unconstrained
+# workload; batch small enough to stay on one decode bucket
+STRUCTURED_BATCH = 4
+STRUCTURED_NEW_TOKENS = 32
 # chaos load harness: seeded open-loop bursty traffic over a live cluster
 # with a mid-stream replica kill, a graceful drain, and a signal-driven
 # autoscale event. Burst sizes are skewed (the first is the heaviest) and
@@ -155,6 +181,11 @@ LOAD_KILL_INDEX = 2      # chunk index after which the tagged replica dies
 LOAD_LONG_FRACTION = 0.3
 LOAD_SHORT_PROMPT = (3, 9)    # uniform token-count range, inclusive-lo
 LOAD_LONG_PROMPT = (48, 81)
+# fraction of load requests carrying response_format="json" (grammar-
+# constrained): exercises the allow-mask path under mixed bursty traffic
+# and through the mid-stream kill — constrained streams ride the same
+# losslessness check as everything else
+LOAD_JSON_FRACTION = 0.2
 # fleet prefix bench: a few distinct system prompts with zipf popularity
 # streamed over a live >=2-replica fleet. Prefix length is a multiple of
 # block_size so the whole system prompt registers as full chain-digest
@@ -585,6 +616,104 @@ def run_spec_decode_bench() -> dict:
     }
 
 
+def run_structured_bench() -> dict:
+    """Grammar-constrained decoding overhead: a small batch of JSON-mode
+    streams (temperature sampling, so the allow-mask actually reshapes
+    the distribution) against the identical unconstrained workload
+    through fresh engines sharing the process-wide jit cache. Because
+    the mask rides the sample pytree as data, both modes run the SAME
+    compiled programs — the measured gap is the host-side FSM walk plus
+    the masked softmax, and the target is single-digit TPOT overhead.
+    Validity is checked the way the test suite does: every constrained
+    stream replays through a fresh DFA cursor, and streams that finished
+    within budget must json-parse."""
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, structured
+
+    mc = LlamaConfig.tiny()
+    rng = np.random.default_rng(13)
+    prompt = [int(t) for t in rng.integers(1, mc.vocab_size, 8)]
+
+    def run(response_format) -> tuple[list[list[int]], float]:
+        eng = LLMEngine(
+            EngineConfig(
+                model="llama",
+                model_config=mc,
+                block_size=8,
+                num_blocks=64,
+                max_batch_size=STRUCTURED_BATCH,
+                max_prefill_batch=STRUCTURED_BATCH,
+                eos_id=0,
+            ),
+            auto_step=False,
+        )
+        streams = [
+            eng.submit(
+                prompt,
+                max_new_tokens=STRUCTURED_NEW_TOKENS,
+                temperature=0.8,
+                seed=100 + i,
+                structured=response_format,
+            )
+            for i in range(STRUCTURED_BATCH)
+        ]
+        t0 = time.perf_counter()
+        for _ in range(10_000):
+            if all(s.done for s in streams) or not eng.step():
+                break
+        while eng.step():  # collapse the trailing in-flight step
+            pass
+        dt = time.perf_counter() - t0
+        toks = [list(s) for s in streams]
+        eng.shutdown()
+        return toks, dt
+
+    # cold grammar compile, measured before the cache can hide it
+    structured.clear_cache()
+    t0 = time.perf_counter()
+    dfa = structured.compile_grammar(
+        structured.parse_response_format("json"), mc.vocab_size, 0
+    )
+    compile_ms = (time.perf_counter() - t0) * 1e3
+
+    run(None)  # warm the jit cache; measured runs below are compile-free
+    run("json")
+    base_toks, base_s = run(None)
+    json_toks, json_s = run("json")
+
+    valid = True
+    for toks in json_toks:
+        cur = structured.FSMCursor(dfa)
+        body = [t for t in toks if t != 0]
+        valid &= all(cur.advance(t) for t in body)
+        if len(toks) < STRUCTURED_NEW_TOKENS:
+            try:
+                json.loads(bytes(body))
+            except ValueError:
+                valid = False
+
+    base_n = sum(len(t) for t in base_toks)
+    json_n = sum(len(t) for t in json_toks)
+    base_tpot = base_s / max(base_n, 1)
+    json_tpot = json_s / max(json_n, 1)
+    return {
+        "llm_structured_valid": bool(valid),
+        "llm_structured_baseline_tokens_per_sec": round(
+            base_n / max(base_s, 1e-9), 1
+        ),
+        "llm_structured_tokens_per_sec": round(
+            json_n / max(json_s, 1e-9), 1
+        ),
+        "llm_structured_tpot_overhead_pct": round(
+            (json_tpot - base_tpot) / max(base_tpot, 1e-9) * 100.0, 2
+        ),
+        "llm_grammar_compile_cold_ms": round(compile_ms, 2),
+        "llm_grammar_dfa_states": int(dfa.n_states),
+    }
+
+
 def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
     """Seeded open-loop request schedule: (index, start offset s, payload)
     per request. Bimodal prompt lengths (LOAD_LONG_FRACTION long-document
@@ -592,13 +721,17 @@ def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
     of the SECOND burst carries the chaos kill tag so the kill lands
     while both the heavy first burst's stragglers and fresh work are in
     flight. Each payload is marked with its ``prompt_class`` so the
-    harness can split decode-TPOT percentiles by class."""
+    harness can split decode-TPOT percentiles by class; a
+    LOAD_JSON_FRACTION minority additionally carries
+    ``response_format="json"`` so grammar-constrained and free-running
+    streams share batches throughout the run."""
     requests = []
     base = 0.0
     idx = 0
     for size in LOAD_BURSTS:
         for _ in range(size):
             is_long = bool(rng.random() < LOAD_LONG_FRACTION)
+            is_json = bool(rng.random() < LOAD_JSON_FRACTION)
             lo, hi = LOAD_LONG_PROMPT if is_long else LOAD_SHORT_PROMPT
             n = int(rng.integers(lo, hi))
             payload = {
@@ -609,6 +742,8 @@ def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
                 "seed": 1000 + idx,
                 "prompt_class": "long" if is_long else "short",
             }
+            if is_json:
+                payload["response_format"] = "json"
             requests.append((idx, base + float(rng.random() * 0.5), payload))
             idx += 1
         base += LOAD_BURST_GAP_S
@@ -933,7 +1068,9 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
     from ray_tpu.exceptions import EngineOverloadedError
     from ray_tpu.models.llama import LlamaConfig
     from ray_tpu.serve.controller import CONTROLLER_NAME
-    from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_llm_app, stream_tokens
+    from ray_tpu.serve.llm import (
+        EngineConfig, LLMEngine, build_llm_app, stream_tokens, structured,
+    )
 
     plan = FaultPlan(seed=LOAD_SEED, faults=(
         Fault(point="llm.token", action="kill",
@@ -1106,17 +1243,30 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
     # -- byte-identity vs an unfaulted single-engine reference --
     ref_eng = LLMEngine(ecfg, auto_step=False)
     lossless = True
+    json_requests = 0
+    json_valid = True
     accepted = [r for r in results if not r["shed"] and r["error"] is None]
     for rec in accepted:
         p = rec["payload"]
         ref = ref_eng.generate(
             p["prompt"], max_new_tokens=p["max_new_tokens"],
             temperature=p["temperature"], seed=p["seed"],
+            structured=p.get("response_format"),
         )
         idxs = [c["index"] for c in rec["chunks"]]
         toks = [c["token"] for c in rec["chunks"]]
         if idxs != list(range(len(idxs))) or toks != ref:
             lossless = False
+        if p.get("response_format"):
+            # constrained streams must also replay through their DFA
+            json_requests += 1
+            dfa = structured.compile_grammar(
+                structured.parse_response_format(p["response_format"]),
+                ecfg.model_config.vocab_size, ecfg.eos_id,
+            )
+            cur = structured.FSMCursor(dfa)
+            body = [t for t in toks if t != ecfg.eos_id]
+            json_valid &= all(cur.advance(t) for t in body)
     ref_eng.shutdown()
 
     total = len(results)
@@ -1208,6 +1358,8 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
             tpots_by_class.get("long", [])),
         "llm_load_prefill_replicas": prefill_replicas,
         "llm_load_lossless": lossless and errors == 0,
+        "llm_load_json_requests": json_requests,
+        "llm_load_json_valid": json_valid,
         "llm_load_failovers": sum(r["failovers"] for r in results),
         "llm_load_scale_events": scale_events,
         "llm_load_max_replicas": max(
@@ -1222,6 +1374,7 @@ def main() -> None:
     _ensure_virtual_devices(SHARDED_DEVICES)
     out = run_serving_bench()
     out.update(run_spec_decode_bench())
+    out.update(run_structured_bench())
     out.update(run_sharded_decode_bench())
     out.update(run_paged_attn_microbench())
     out.update(

@@ -32,6 +32,32 @@ import jax
 import jax.numpy as jnp
 
 
+def apply_allow_mask(logits: jax.Array, mask: jax.Array | None) -> jax.Array:
+    """Apply a packed uint32 allow-bitmask to ``logits`` [..., V].
+
+    ``mask`` is ``[..., ceil(V/32)]`` uint32, little-endian packed (bit
+    j of word w allows token ``w*32 + j``) — the grammar-constrained
+    decoding mask staged by serve/llm/structured.py. Disallowed tokens
+    go to ``-inf`` BEFORE the greedy argmax and the top-k sort, so the
+    constrained token is still the same pure f(logits, seed, position)
+    the failover-resume contract keys on; an all-ones mask is a bitwise
+    identity, which is what keeps unconstrained rows byte-identical to
+    a maskless build. Rows whose mask allows nothing are left unmasked
+    (never NaN): the host-side FSM has already gone dead for such a row
+    and terminates the stream, so its sampled token is never emitted.
+    """
+    if mask is None:
+        return logits
+    bits = (
+        mask[..., None] >> jnp.arange(32, dtype=jnp.uint32)
+    ) & jnp.uint32(1)
+    allow = bits.reshape(mask.shape[:-1] + (mask.shape[-1] * 32,))
+    allow = allow[..., : logits.shape[-1]] != 0
+    any_allowed = jnp.any(allow, axis=-1, keepdims=True)
+    allow = allow | ~any_allowed
+    return jnp.where(allow, logits, -jnp.inf)
+
+
 def _sampled_row(
     logits: jax.Array,
     seed: jax.Array,
@@ -79,8 +105,11 @@ def sample_tokens(
     generated token sits at len(prompt)). ``sample`` is a pytree of [B]
     arrays: ``seeds`` (uint32), ``temperature`` (f32, <= 0 -> greedy),
     ``top_k`` (int32, 0 -> full distribution), ``top_p`` (f32, >= 1 or
-    <= 0 -> disabled). Returns [B] int32 token ids.
+    <= 0 -> disabled), plus an optional ``mask`` ([B, ceil(V/32)]
+    uint32 packed allow-bitmask; all-ones = unconstrained — see
+    ``apply_allow_mask``). Returns [B] int32 token ids.
     """
+    logits = apply_allow_mask(logits, sample.get("mask"))
     seeds = sample["seeds"]
     temperature = sample["temperature"]
     top_k = sample["top_k"]
@@ -140,7 +169,17 @@ def verify_tokens(
     positions = (
         starts[:, None] + 1 + jnp.arange(W, dtype=jnp.int32)[None, :]
     )  # [B, W]
-    tiled = {k: jnp.repeat(v, W, axis=0) for k, v in sample.items()}
+    # per-row [B] leaves tile across the window; per-column leaves
+    # ([B, W, ...] — the structured-decoding mask stages one allow-set
+    # per window position) flatten row-major to match logits/positions
+    tiled = {
+        k: (
+            v.reshape((B * W,) + v.shape[2:])
+            if v.ndim >= 2
+            else jnp.repeat(v, W, axis=0)
+        )
+        for k, v in sample.items()
+    }
     tgt = sample_tokens(
         logits.reshape(B * W, -1), positions.reshape(B * W), tiled
     ).reshape(B, W)

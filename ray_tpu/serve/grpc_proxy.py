@@ -89,6 +89,10 @@ def _code_for(e: BaseException):
         return grpc.StatusCode.DEADLINE_EXCEEDED
     if isinstance(e, RequestCancelledError):
         return grpc.StatusCode.CANCELLED
+    if isinstance(e, ValueError):
+        # request validation (incl. structured.GrammarError for a bad
+        # response_format) — the client's error, mirrors HTTP 400
+        return grpc.StatusCode.INVALID_ARGUMENT
     return grpc.StatusCode.INTERNAL
 
 

@@ -140,7 +140,15 @@ class LLMDeployment:
 
         payload: {"prompt": str | [int], "max_new_tokens"?, "temperature"?,
         "top_k"?, "top_p"?, "seed"?, "request_id"?, "deadline_s"?,
-        "prior_tokens"?}.
+        "prior_tokens"?, "response_format"?, "stop"?}.
+        ``response_format`` selects grammar-constrained decoding
+        (serve/llm/structured.py): ``"json"``/``"json_object"`` or an
+        OpenAI-shaped dict ({"type": "json_schema", "schema": ...} /
+        {"type": "regex", "pattern": ...}); invalid or unsatisfiable
+        grammars fail the request with a ``ValueError`` (HTTP 400 /
+        gRPC INVALID_ARGUMENT at the proxies). ``stop`` is a list of
+        stop sequences — strings (byte-level encoded like the prompt)
+        or token-id lists — that terminate the stream once emitted.
         Chunks: {"request_id": str, "token": id, "index": i, "text": str}
         where ``index`` is absolute — a resumed stream continues the
         numbering of the stream it replaces.
@@ -177,6 +185,11 @@ class LLMDeployment:
                 prompt, handoff, tag=payload.get("chaos_tag")
             )
         deadline_s = payload.get("deadline_s")
+        stop = []
+        for seq in payload.get("stop") or ():
+            if isinstance(seq, str):
+                seq = encode_text(seq, self.engine.model_cfg.vocab_size)
+            stop.append(tuple(int(t) for t in seq))
         sampling = SamplingParams(
             max_new_tokens=max_new - len(prior),
             temperature=float(payload.get("temperature", 0.0)),
@@ -185,6 +198,8 @@ class LLMDeployment:
             seed=int(payload.get("seed", 0)),
             deadline_s=float(deadline_s) if deadline_s is not None else None,
             start_index=len(prior),
+            structured=payload.get("response_format"),
+            stop=tuple(stop),
         )
         # the replica method runs inside a task_span when the caller was
         # traced — hand that context to the engine so its phase spans join

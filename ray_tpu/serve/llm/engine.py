@@ -84,6 +84,7 @@ Failure semantics (docs/SERVING_LLM.md "Failure semantics"):
 from __future__ import annotations
 
 import logging
+import math
 import queue
 import threading
 import time
@@ -101,7 +102,7 @@ from ray_tpu.exceptions import (
     RequestCancelledError,
 )
 from ray_tpu.serve._shapes import pad_to_bucket, pow2_buckets
-from ray_tpu.serve.llm import obs
+from ray_tpu.serve.llm import obs, structured
 from ray_tpu.serve.llm.executor import build_executor
 from ray_tpu.serve.llm.kv_cache import KVCacheConfig, PagedKVCache, _block_key
 from ray_tpu.util import metrics, tracing
@@ -134,21 +135,59 @@ def _window_rate(clocks: deque, now: float) -> float:
     return len(clocks) / _SIGNAL_RATE_WINDOW_S
 
 
+# sanity ceiling for max_new_tokens: far above any model's max_seq_len
+# (which submit() checks against anyway) but low enough to catch sign
+# bugs and unit mistakes at construction time, where the field is named
+_MAX_NEW_TOKENS_CAP = 1 << 20
+
+
 @dataclass(frozen=True)
 class SamplingParams:
     max_new_tokens: int = 16
-    temperature: float = 0.0  # <= 0 -> greedy
-    top_k: int = 0            # 0 -> full distribution
-    top_p: float = 1.0        # nucleus mass; >= 1 (or <= 0) -> disabled
+    temperature: float = 0.0  # 0 -> greedy
+    top_k: int = 0            # 0 or -1 -> full distribution
+    top_p: float = 1.0        # nucleus mass in (0, 1]; 1.0 -> disabled
     seed: int = 0
     deadline_s: float | None = None  # wall-clock budget from submit()
     start_index: int = 0      # tokens already delivered (failover resume)
+    # grammar constraint (serve/llm/structured.py): None, "json" /
+    # "json_object", a response_format dict, or a GrammarSpec
+    structured: Any = None
+    # stop sequences: token-id sequences that terminate the stream when
+    # they appear as a suffix of the generated tokens (the matched stop
+    # tokens ARE emitted, like EOS). Normalized to a tuple of tuples.
+    stop: Any = ()
 
     def __post_init__(self):
-        if self.max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
+        if not (1 <= self.max_new_tokens <= _MAX_NEW_TOKENS_CAP):
+            raise ValueError(
+                f"max_new_tokens must be in [1, {_MAX_NEW_TOKENS_CAP}], "
+                f"got {self.max_new_tokens}"
+            )
         if self.start_index < 0:
             raise ValueError("start_index must be >= 0")
+        if not math.isfinite(self.temperature) or self.temperature < 0:
+            raise ValueError(
+                f"temperature must be finite and >= 0, got "
+                f"{self.temperature}"
+            )
+        if self.top_k < -1:
+            raise ValueError(
+                f"top_k must be >= -1 (0 or -1 disables), got {self.top_k}"
+            )
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {self.top_p}"
+            )
+        norm = []
+        for seq in self.stop:
+            if isinstance(seq, int):
+                seq = (seq,)
+            seq = tuple(int(t) for t in seq)
+            if not seq:
+                raise ValueError("stop sequences must be non-empty")
+            norm.append(seq)
+        object.__setattr__(self, "stop", tuple(norm))
 
 
 @dataclass(frozen=True)
@@ -260,6 +299,9 @@ class _Request:
         # that include this row, and whether its KV blocks went back to
         # the pool (exactly-once release under the lag)
         "inflight", "blocks_released",
+        # grammar-constrained decoding: the request's FSM cursor
+        # (structured.FSMCursor) or None when unconstrained
+        "fsm",
         # lifecycle observability (ISSUE 4): the phase timeline rides the
         # request, and a stored trace context turns it into spans on finish
         "trace_ctx", "timeline", "submitted_clock", "first_token_clock",
@@ -297,6 +339,7 @@ class _Request:
         self.skips = 0            # admissions that jumped over this head
         self.table_np: np.ndarray | None = None  # cached host block table
         self.table_key: tuple | None = None      # (nb, table_version)
+        self.fsm = None  # structured.FSMCursor when grammar-constrained
         self.done = False
         self.deadline = (
             time.monotonic() + sampling.deadline_s
@@ -563,6 +606,17 @@ class LLMEngine:
             "llm_host_cache_blocks",
             "Demoted KV blocks resident in the host cache tier",
         )
+        self._m_structured = metrics.counter(
+            "llm_structured_requests",
+            "Requests admitted with a grammar constraint "
+            "(response_format / SamplingParams.structured)",
+        )
+        self._m_masked_frac = metrics.histogram(
+            "llm_structured_masked_fraction",
+            "Fraction of the vocab banned by the grammar allow-mask at "
+            "each constrained decode position",
+            boundaries=(0.5, 0.9, 0.99, 0.995, 0.999, 0.9999),
+        )
         self._m_ttft = obs.ttft_histogram()
         self._m_tpot = obs.tpot_histogram()
         self._m_queue_wait = obs.queue_wait_histogram()
@@ -652,6 +706,29 @@ class LLMEngine:
                 f"request needs {need} KV blocks "
                 f"but the pool only has {self.cache.cfg.usable_blocks}"
             )
+        # grammar constraint: compile (LRU-cached) and position the FSM
+        # cursor OUTSIDE the scheduler lock — compile is submit-path
+        # work, and a bad grammar is the client's error (GrammarError is
+        # a ValueError -> the proxies answer 400, never 500)
+        fsm = None
+        spec = structured.parse_response_format(sampling.structured)
+        if spec is not None:
+            dfa = structured.compile_grammar(
+                spec, self.model_cfg.vocab_size, self.cfg.eos_id
+            )
+            fsm = structured.FSMCursor(dfa)
+            if sampling.start_index > 0:
+                # failover resume: replay the already-delivered tokens
+                # (the prompt tail) so the cursor lands where the dead
+                # replica's stood
+                for t in prompt[-sampling.start_index:]:
+                    if not fsm.advance(t):
+                        raise structured.GrammarError(
+                            f"resumed prefix rejected by the grammar at "
+                            f"token {t} (response_format mismatch on "
+                            "resume?)"
+                        )
+            self._m_structured.inc()
         if self._failed is not None:
             raise self._failed
         with self._lock:
@@ -670,6 +747,7 @@ class LLMEngine:
                     "retry later"
                 )
             req = _Request(self._next_id, prompt, sampling, trace_ctx)
+            req.fsm = fsm
             self._next_id += 1
             req.submitted_clock = obs.clock()
             self._tl(req, "submitted", prompt_tokens=len(prompt),
@@ -876,6 +954,10 @@ class LLMEngine:
                 "spec_committed_per_step": (
                     self._spec_committed_total / max(1, self._spec_steps)
                 ),
+                "structured_running": sum(
+                    1 for r in self._running if r.fsm is not None
+                ),
+                "grammar_cache": structured.cache_stats(),
                 "goodput": {
                     k: dict(v) for k, v in self._goodput_last.items()
                 },
@@ -1419,8 +1501,18 @@ class LLMEngine:
                                         emitted)
                     return
         # list equality is element identity here: same _Request objects
-        # in the same order <=> nothing joined/finished/evicted
-        steady = pending is not None and batch == pending.batch
+        # in the same order <=> nothing joined/finished/evicted.
+        # Grammar-constrained rows force the lag to collapse every step:
+        # the allow-mask staged for step N+1 is a function of the FSM
+        # state AFTER step N's token, which only exists host-side once
+        # N's ids are synced — so reconcile first, then dispatch (lag-0
+        # for constrained batches, the dispatch-ahead win preserved for
+        # everything else).
+        constrained = any(r.fsm is not None for r in batch)
+        steady = (
+            pending is not None and batch == pending.batch
+            and not constrained
+        )
         if pending is not None and not steady:
             emitted += self._reconcile_locked(pending)
             pending = None
@@ -1561,6 +1653,13 @@ class LLMEngine:
                     if not 0 <= t < V or len(clean) >= k_eff:
                         break
                     clean.append(t)
+                if r.fsm is not None and clean:
+                    # constrained rows: only a grammar-valid prefix can
+                    # ever be accepted, so truncate at the first token
+                    # the DFA rejects — verify stays lossless, and an
+                    # empty draft is the per-request spec-off fallback
+                    # (that row degenerates to a 1-token verify)
+                    clean = r.fsm.filter_draft(clean)
             out.append(clean)
             any_draft = any_draft or bool(clean)
         return out if any_draft else None
@@ -1624,9 +1723,21 @@ class LLMEngine:
             starts[i] = r.total_len - 1
             dlen[i] = len(props)
             tables[i] = self._table_for(r, nb)
+        sample = self._sample_args_locked(batch, B)
+        # verify windows need one allow-mask PER COLUMN (column s is
+        # sampled from the FSM state after consuming props[:s]) — the
+        # [B, W, words] leaf replaces the per-row decode mask, staged
+        # all-ones for unconstrained rows so the verify pytree (and the
+        # compile kind) is identical for mixed batches
+        words = (self.model_cfg.vocab_size + 31) // 32
+        vf_mask = self._scratch_buf("vf_mask", (B, W, words), np.uint32)
+        vf_mask[:] = 0xFFFFFFFF
+        for i, (r, props) in enumerate(zip(batch, proposals)):
+            if r.fsm is not None:
+                r.fsm.stage_verify_masks(vf_mask[i], props)
+        sample["mask"] = vf_mask
         packed_dev = self.executor.verify_step(
-            tokens, starts, dlen, tables,
-            sample=self._sample_args_locked(batch, B),
+            tokens, starts, dlen, tables, sample=sample,
         )
         packed = self._sync_verify_locked(packed_dev)
         # a completed sync proves every earlier dispatch executed
@@ -1757,6 +1868,14 @@ class LLMEngine:
         temp = self._scratch_buf("sp_temp", (B,), np.float32)
         top_k = self._scratch_buf("sp_top_k", (B,), np.int32)
         top_p = self._scratch_buf("sp_top_p", (B,), np.float32)
+        # the grammar allow-mask leaf is ALWAYS staged (all-ones = no
+        # constraint): mask is data, not signature, so constrained and
+        # unconstrained rows share one decode program and the compile
+        # kind set never grows (ops/sampling.apply_allow_mask is a
+        # bitwise identity on all-ones rows)
+        words = (self.model_cfg.vocab_size + 31) // 32
+        mask = self._scratch_buf("sp_mask", (B, words), np.uint32)
+        mask[:] = 0xFFFFFFFF
         n = len(batch)
         seeds[n:] = 0
         temp[n:] = 0.0
@@ -1768,11 +1887,15 @@ class LLMEngine:
             temp[i] = sp.temperature
             top_k[i] = sp.top_k
             top_p[i] = sp.top_p
+            if r.fsm is not None:
+                mask[i] = r.fsm.allow_row()
+                self._m_masked_frac.observe(r.fsm.masked_fraction())
         return {
             "seeds": seeds,
             "temperature": temp,
             "top_k": top_k,
             "top_p": top_p,
+            "mask": mask,
         }
 
     def _scratch_buf(self, name: str, shape: tuple, dtype) -> np.ndarray:
@@ -1792,6 +1915,15 @@ class LLMEngine:
         return slot[slot[2]]
 
     def _emit_token_locked(self, r: _Request, tok: int) -> None:
+        is_eos = self.cfg.eos_id is not None and tok == self.cfg.eos_id
+        if r.fsm is not None and not is_eos:
+            # advance the grammar cursor on the already-synced id BEFORE
+            # emitting: a rejection (only reachable if on-device masking
+            # degraded) terminates the stream WITHOUT the bad token, so
+            # every prefix a client ever sees is grammar-valid
+            if not self._advance_fsm_locked(r, tok):
+                self._complete_locked(r)
+                return
         r.generated.append(tok)
         now = obs.clock()
         if r.first_token_clock is None:
@@ -1808,9 +1940,56 @@ class LLMEngine:
         self._m_tokens.inc()
         if (
             len(r.generated) >= r.sampling.max_new_tokens
-            or (self.cfg.eos_id is not None and tok == self.cfg.eos_id)
+            or is_eos
+            or (r.fsm is not None and r.fsm.must_stop)
+            or self._hits_stop_locked(r)
         ):
             self._complete_locked(r)
+
+    def _advance_fsm_locked(self, r: _Request, tok: int) -> bool:
+        """Advance one request's grammar cursor on an emitted token id
+        (host ints from the blessed sync — never a device value). With
+        on-device masking a rejection here is a degradation path, so it
+        is LOUD by contract: log and terminate, never emit silently."""
+        try:
+            ok = r.fsm.advance(tok)
+        except (IndexError, TypeError, ValueError) as e:
+            logger.error(
+                "grammar FSM advance failed for %r on token %d: %r",
+                r.id, tok, e,
+            )
+            return False
+        if not ok:
+            logger.warning(
+                "grammar rejected sampled token %d for %r "
+                "(state=%d, dead=%s) — terminating the stream early",
+                tok, r.id, r.fsm.state, r.fsm.dead,
+            )
+        return ok
+
+    def _hits_stop_locked(self, r: _Request) -> bool:
+        """True when the just-emitted token completes one of the
+        request's stop sequences. The match window spans the failover
+        resume boundary: a resumed request's already-delivered tokens
+        are its prompt tail (start_index of them), so a stop sequence
+        straddling the kill point still fires on the survivor."""
+        stops = r.sampling.stop
+        if not stops:
+            return False
+        gen = r.generated
+        si = r.sampling.start_index
+        for seq in stops:
+            L = len(seq)
+            if L <= len(gen):
+                if tuple(gen[-L:]) == seq:
+                    return True
+            else:
+                need = L - len(gen)
+                if si >= need and (
+                    tuple(r.prompt[-need:]) + tuple(gen) == seq
+                ):
+                    return True
+        return False
 
     def _complete_locked(self, r: _Request) -> None:
         r.done = True

@@ -149,8 +149,22 @@ class ModelExecutor:
         return jnp.asarray(x)
 
     def _dev_sample(self, sample: dict | None):
+        """Move the engine's ``sample=`` staging pytree on-device. The
+        grammar allow-mask leaf rides here like every other control:
+        ``[B, ceil(V/32)]`` uint32 for decode steps, ``[B, W, words]``
+        for verify windows (one allow-set per column). Validated at the
+        seam — a wrongly-typed mask would silently allow everything
+        after the kernel's bit unpack — and shared by both
+        SingleDeviceExecutor and ShardedExecutor (mask is replicated
+        data; the sampler applies it after the logits all-reduce)."""
         if sample is None:
             return None
+        mask = sample.get("mask")
+        if mask is not None:
+            assert mask.dtype == np.uint32 and mask.ndim in (2, 3), (
+                "grammar allow-mask must be packed uint32 [B, words] or "
+                f"[B, W, words], got {mask.dtype}/{mask.shape}"
+            )
         return {k: self._dev(v) for k, v in sample.items()}
 
     # ---------------- the step interface ----------------

@@ -74,7 +74,10 @@ def _unwrap(e: BaseException) -> BaseException:
 def _status_for(e: BaseException) -> tuple[int, dict]:
     """Map framework errors to HTTP degradation statuses: overload is
     retryable (503 + Retry-After), a blown deadline is a gateway timeout
-    (504), a cancelled request is nginx's client-closed-request (499)."""
+    (504), a cancelled request is nginx's client-closed-request (499),
+    and a request-validation ValueError — including GrammarError for an
+    invalid or unsatisfiable response_format — is the client's fault
+    (400, never a 500/failover)."""
     from ray_tpu.util import metrics
 
     e = _unwrap(e)
@@ -89,6 +92,8 @@ def _status_for(e: BaseException) -> tuple[int, dict]:
         return 504, {}
     if isinstance(e, RequestCancelledError):
         return 499, {}
+    if isinstance(e, ValueError):
+        return 400, {}
     return 500, {}
 
 

@@ -168,6 +168,13 @@ def test_device_values_cross_host_only_in_host_tokens():
     assert any(p.name == "drafter.py" for p in targets), (
         "drafter.py missing from serve/llm lint targets"
     )
+    # grammar-constrained decoding (ISSUE 16) is covered by the same
+    # bar: FSM cursors advance on the already-synced host ids from
+    # _host_tokens and the mask table is pure numpy — structured.py
+    # must never pull a device value (zero new sync points)
+    assert any(p.name == "structured.py" for p in targets), (
+        "structured.py missing from serve/llm lint targets"
+    )
     allowed = {
         ("executor.py", "_host_tokens"),
         ("executor.py", "_host_blocks"),
@@ -267,7 +274,15 @@ def test_handoff_retry_paths_never_swallow_silently():
     router's prompt-digest computation (handle.py ``_prompt_digests``)
     degrades to plain load balancing on any error, which likewise must
     leave a trace or prefix routing can silently stop working
-    fleet-wide."""
+    fleet-wide.
+
+    Grammar-constrained decoding (ISSUE 16) adds two degradation
+    paths: a grammar compile failure (structured.py
+    ``compile_grammar``) must surface as the client-visible
+    GrammarError — swallowed, the request would silently run
+    UNCONSTRAINED — and an FSM-advance failure (engine.py
+    ``_advance_fsm_locked``) terminates the stream early, which is
+    only diagnosable if the rejection is logged."""
     import ast
     import pathlib
 
@@ -291,6 +306,12 @@ def test_handoff_retry_paths_never_swallow_silently():
         root / "ray_tpu" / "serve" / "controller.py": frozenset({
             "_recover", "_checkpoint", "_adopt_replica",
             "_reap_orphans", "_readopt_proxies",
+        }),
+        root / "ray_tpu" / "serve" / "llm" / "structured.py": frozenset({
+            "compile_grammar",
+        }),
+        root / "ray_tpu" / "serve" / "llm" / "engine.py": frozenset({
+            "_advance_fsm_locked",
         }),
     }
     offenders = []
