@@ -429,6 +429,7 @@ def main() -> None:
         except Exception:
             try:
                 proc.kill()
+                proc.wait()  # reap — a killed-but-unwaited child is a zombie
             except Exception:
                 pass
 

@@ -90,7 +90,15 @@ Reported (one JSON line, merged into bench.py's aux results under
                               and reports ``llm_load_json_requests`` /
                               ``llm_load_json_valid`` (every constrained
                               stream replays through its DFA, through
-                              the kill included)
+                              the kill included); traffic is mixed-class
+                              with engine preemption enabled, reporting
+                              ``llm_load_ttft_p99_ms_interactive`` /
+                              ``_batch``,
+                              ``llm_load_interactive_ttft_ratio``
+                              (loaded-vs-unloaded interactive TTFT p99,
+                              bar <= 1.5), ``llm_load_batch_dropped``
+                              (bar 0 — batch preempts and resumes, never
+                              drops) and ``llm_load_preemptions``
 
 - ``llm_structured_tokens_per_sec`` / ``llm_structured_tpot_overhead_pct``
                               grammar-constrained decoding
@@ -186,6 +194,18 @@ LOAD_LONG_PROMPT = (48, 81)
 # and through the mid-stream kill — constrained streams ride the same
 # losslessness check as everything else
 LOAD_JSON_FRACTION = 0.2
+# Mixed priority classes (ISSUE 17): a batch minority shares bursts with
+# interactive traffic, and the engine runs with preemption enabled — under
+# saturation batch streams pause onto the host KV tier instead of being
+# shed, so interactive TTFT holds while every batch stream still finishes.
+# The acceptance bar: interactive TTFT p99 within 1.5x of its unloaded
+# baseline AND zero batch streams dropped.
+LOAD_BATCH_FRACTION = 0.4
+LOAD_BASELINE_REQUESTS = 4    # unloaded interactive TTFT baseline probes
+LOAD_PREEMPTION = {           # aggressive thresholds: CPU tiny-model scale
+    "kv_pressure": 0.75, "queue_wait_s": 0.08,
+    "resume_pressure": 0.5, "aging_s": 8.0,
+}
 # fleet prefix bench: a few distinct system prompts with zipf popularity
 # streamed over a live >=2-replica fleet. Prefix length is a multiple of
 # block_size so the whole system prompt registers as full chain-digest
@@ -724,7 +744,9 @@ def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
     harness can split decode-TPOT percentiles by class; a
     LOAD_JSON_FRACTION minority additionally carries
     ``response_format="json"`` so grammar-constrained and free-running
-    streams share batches throughout the run."""
+    streams share batches throughout the run. A LOAD_BATCH_FRACTION
+    minority is tagged ``priority="batch"`` (the rest interactive) so
+    the preemptive scheduler has victims to pause under pressure."""
     requests = []
     base = 0.0
     idx = 0
@@ -732,6 +754,7 @@ def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
         for _ in range(size):
             is_long = bool(rng.random() < LOAD_LONG_FRACTION)
             is_json = bool(rng.random() < LOAD_JSON_FRACTION)
+            is_batch = bool(rng.random() < LOAD_BATCH_FRACTION)
             lo, hi = LOAD_LONG_PROMPT if is_long else LOAD_SHORT_PROMPT
             n = int(rng.integers(lo, hi))
             payload = {
@@ -741,6 +764,7 @@ def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
                 "temperature": 0.8,
                 "seed": 1000 + idx,
                 "prompt_class": "long" if is_long else "short",
+                "priority": "batch" if is_batch else "interactive",
             }
             if is_json:
                 payload["response_format"] = "json"
@@ -1053,7 +1077,17 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
     Accepted streams are compared byte-for-byte against an unfaulted
     local reference engine; requests shed by cluster-wide admission
     (EngineOverloadedError at dispatch) count toward
-    ``llm_load_shed_rate`` and nothing else."""
+    ``llm_load_shed_rate`` and nothing else.
+
+    Traffic is mixed-class (ISSUE 17): a LOAD_BATCH_FRACTION minority
+    carries ``priority="batch"`` and the engines run with preemption
+    enabled (LOAD_PREEMPTION), so under saturation batch streams pause
+    onto the host KV tier instead of being shed. Before the load window
+    LOAD_BASELINE_REQUESTS solo interactive probes record the unloaded
+    TTFT baseline; the report then carries interactive-vs-baseline TTFT
+    p99 (`llm_load_interactive_ttft_ratio`, bar: <= 1.5) and
+    `llm_load_batch_dropped` (bar: 0 — preempted streams all complete,
+    byte-identical through the same losslessness check)."""
     import dataclasses
     import threading
 
@@ -1085,7 +1119,8 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
     # the local reference engine (same seed -> same weights)
     mc = dataclasses.replace(
         LlamaConfig.tiny(), dtype=jnp.float32, attention="xla")
-    ecfg = EngineConfig(model="llama", model_config=mc, seed=0)
+    ecfg = EngineConfig(model="llama", model_config=mc, seed=0,
+                        preemption=dict(LOAD_PREEMPTION))
     rng = np.random.default_rng(LOAD_SEED)
     requests = _load_schedule(rng, mc.vocab_size)
 
@@ -1157,6 +1192,30 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
             if prefill_replicas > 0 else None
         )
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+
+        # -- unloaded interactive TTFT baseline: solo sequential probes
+        # before any load exists. They double as jit warmup, so the loaded
+        # window ahead isn't paying compile time the baseline skipped.
+        baseline_ttfts: list[float] = []
+        for b in range(LOAD_BASELINE_REQUESTS):
+            bp = {
+                "prompt": [int(x) for x in rng.integers(1, mc.vocab_size, 6)],
+                "request_id": f"load-base-{b}",
+                "max_new_tokens": LOAD_NEW_TOKENS,
+                "temperature": 0.8,
+                "seed": 900 + b,
+                "priority": "interactive",
+            }
+            tb = time.perf_counter()
+            first = None
+            # drain the whole stream (abandoning it mid-generation would
+            # leave the probe running on the replica under the real load)
+            for chunk in stream_tokens(
+                    handle, bp, prefill_handle=prefill_handle):
+                if first is None:
+                    first = time.perf_counter() - tb
+            if first is not None:
+                baseline_ttfts.append(first)
 
         def sampler():
             while not stop.is_set():
@@ -1274,6 +1333,20 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
     errors = sum(1 for r in results if r["error"] is not None)
     ttfts = [r["arrivals"][0] - r["dispatched"]
              for r in accepted if r["arrivals"]]
+    ttfts_by_prio: dict[str, list[float]] = {}
+    for r in accepted:
+        if r["arrivals"]:
+            prio = r["payload"].get("priority", "default")
+            ttfts_by_prio.setdefault(prio, []).append(
+                r["arrivals"][0] - r["dispatched"])
+    batch_total = sum(
+        1 for r in results if r["payload"].get("priority") == "batch")
+    # the acceptance bar: batch degrades by WAITING (preempt/park/resume),
+    # never by being dropped — a shed or errored batch stream is a drop
+    batch_dropped = sum(
+        1 for r in results
+        if r["payload"].get("priority") == "batch"
+        and (r["shed"] or r["error"] is not None))
     tpots: list[float] = []
     tpots_by_class: dict[str, list[float]] = {"short": [], "long": []}
     for r in accepted:
@@ -1357,6 +1430,25 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
         "llm_load_decode_tpot_p99_ms_long": _p99_ms(
             tpots_by_class.get("long", [])),
         "llm_load_prefill_replicas": prefill_replicas,
+        # mixed-class degradation report (ISSUE 17): interactive holds its
+        # latency under saturation, batch waits but always completes
+        "llm_load_ttft_p99_ms_interactive": _p99_ms(
+            ttfts_by_prio.get("interactive", [])),
+        "llm_load_ttft_p99_ms_batch": _p99_ms(
+            ttfts_by_prio.get("batch", [])),
+        "llm_load_ttft_unloaded_p99_ms": _p99_ms(baseline_ttfts),
+        "llm_load_interactive_ttft_ratio": (
+            round(float(np.percentile(
+                ttfts_by_prio["interactive"], 99))
+                / max(float(np.percentile(baseline_ttfts, 99)), 1e-9), 3)
+            if ttfts_by_prio.get("interactive") and baseline_ttfts
+            else None),
+        "llm_load_batch_requests": batch_total,
+        "llm_load_batch_dropped": batch_dropped,
+        "llm_load_preemptions": (
+            int(_fleet_counter_total(
+                fleet["families"], "llm_preemptions_total"))
+            if fleet is not None else None),
         "llm_load_lossless": lossless and errors == 0,
         "llm_load_json_requests": json_requests,
         "llm_load_json_valid": json_valid,

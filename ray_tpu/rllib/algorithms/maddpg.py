@@ -280,6 +280,9 @@ class MADDPG(Algorithm):
             "iteration": self.iteration,
             "params": jax.tree.map(np.asarray, self.params),
             "target_params": jax.tree.map(np.asarray, self.target_params),
+            # per-agent Adam moments — without them a resumed run silently
+            # restarts optimization from zeroed first/second moments
+            "opt": jax.tree.map(np.asarray, self._opt),
             "env_steps": self._env_steps,
         }
 
@@ -287,4 +290,6 @@ class MADDPG(Algorithm):
         self.iteration = state["iteration"]
         self.params = state["params"]
         self.target_params = state["target_params"]
+        if "opt" in state:
+            self._opt = state["opt"]
         self._env_steps = state["env_steps"]

@@ -218,12 +218,19 @@ class CRR(Algorithm):
     def save_state(self) -> dict:
         state = super().save_state()
         state["critic"] = self.critic.state()
+        state["grad_steps"] = self._grad_steps
         return state
 
     def load_state(self, state: dict) -> None:
         super().load_state(state)
         if "critic" in state:
             self.critic.load_state(state["critic"])
+            # the target network is derived state — rebuild it from the
+            # restored critic, or TD targets bootstrap from a fresh-init
+            # network until the next target_update_freq boundary
+            self._target_params = self.critic.get_weights_np()
+        if "grad_steps" in state:
+            self._grad_steps = state["grad_steps"]
 
     def _sample_all(self):  # pragma: no cover — offline only
         raise RuntimeError("offline algorithm does not sample")

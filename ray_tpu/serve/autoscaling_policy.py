@@ -96,13 +96,16 @@ def snapshot_is_hot(config: AutoscalingConfig, snap: Mapping) -> bool:
 
 
 def snapshot_is_cold(config: AutoscalingConfig, snap: Mapping) -> bool:
-    """One replica is fully idle: nothing queued, nothing decoding, and the
-    KV pool below the downscale pressure bound (LRU-cached prefix blocks
-    are reclaimable, so they don't count against coldness)."""
+    """One replica is fully idle: nothing queued, nothing decoding, no
+    stream parked in ``preempted`` (a parked stream holds no blocks but IS
+    pending work — draining the replica would orphan it), and the KV pool
+    below the downscale pressure bound (LRU-cached prefix blocks are
+    reclaimable, so they don't count against coldness)."""
     return (
         snap.get("queue_depth", 0) == 0
         and snap.get("running", 0) == 0
         and snap.get("prefilling", 0) == 0
+        and snap.get("preempted_streams", 0) == 0
         and snap.get("kv_pool_pressure", 0.0) <= config.downscale_kv_pressure
     )
 
@@ -148,6 +151,39 @@ def fleet_saturated(
         snapshot_is_hot(config, s) and s.get("queue_depth", 0) > 0
         for s in snapshots
     )
+
+
+def shed_classes(
+    config: AutoscalingConfig,
+    snapshots: Sequence[Mapping],
+    current_num_replicas: int,
+) -> tuple:
+    """Which priority classes to reject at admission, batch-first.
+
+    Graduated degradation between "serve everything" and the binary
+    fleet_saturated shed: once the fleet is at max_replicas and every
+    replica reports ``preempt_exhausted`` (pressure holds but no running
+    stream is outranked by a waiter — preemption has no more room to
+    make), new low-priority work is doomed to park or starve, so reject
+    it at the router instead. The preemption thresholds sit BELOW the
+    upscale/hot thresholds, so this fires in the band before
+    fleet_saturated does — batch sheds first; the default class joins
+    only when every replica also shows default-class backlog
+    (``queue_depth_by_class``); interactive is only ever shed by the
+    full fleet_saturated signal, which supersedes this one.
+    """
+    if fleet_saturated(config, snapshots, current_num_replicas):
+        return ("batch", "default", "interactive")
+    if current_num_replicas < config.max_replicas or not snapshots:
+        return ()
+    exhausted = all(s.get("preempt_exhausted", False) for s in snapshots)
+    if not exhausted:
+        return ()
+    default_backlogged = all(
+        (s.get("queue_depth_by_class") or {}).get("default", 0) > 0
+        for s in snapshots
+    )
+    return ("batch", "default") if default_backlogged else ("batch",)
 
 
 class AutoscalingDecider:

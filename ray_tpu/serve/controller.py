@@ -32,7 +32,11 @@ from ray_tpu._private import chaos, serialization
 from ray_tpu._private.gcs import kv_del, kv_get, kv_put
 from ray_tpu._private.ids import ActorID
 from ray_tpu.actor import ActorClass, ActorHandle
-from ray_tpu.serve.autoscaling_policy import AutoscalingDecider, fleet_saturated
+from ray_tpu.serve.autoscaling_policy import (
+    AutoscalingDecider,
+    fleet_saturated,
+    shed_classes,
+)
 from ray_tpu.serve.config import DeploymentConfig
 from ray_tpu.serve.llm import obs
 from ray_tpu.serve.replica import ReplicaActor
@@ -173,6 +177,10 @@ class _DeploymentState:
         self.snapshots: dict[bytes, tuple[float, dict]] = {}
         # cluster-wide admission: routers shed new work while True
         self.shed = False
+        # graduated degradation: priority classes routers reject while
+        # preemption is exhausted fleet-wide (batch first); independent of
+        # the binary shed bit, which rejects everything
+        self.shed_classes: tuple = ()
 
 
 class _ProxyState:
@@ -390,6 +398,7 @@ class ServeController:
                         # doomed requests shed at the edge (503+Retry-After)
                         # instead of queueing behind a saturated fleet
                         "shed": ds.shed,
+                        "shed_classes": list(ds.shed_classes),
                         "prefix_summaries": summaries,
                         "prefix_block_size": prefix_block,
                         "prefix_vocab_size": prefix_vocab,
@@ -415,6 +424,7 @@ class ServeController:
                             1 for r in ds.replicas if r.state == "DRAINING"
                         ),
                         "shedding": ds.shed,
+                        "shed_classes": list(ds.shed_classes),
                         "message": ds.last_error or "",
                     }
                     for name, ds in app["deployments"].items()
@@ -812,9 +822,13 @@ class ServeController:
                 shed = fleet_saturated(
                     ds.config.autoscaling_config, snaps, ds.target
                 )
-                if shed != ds.shed:
+                shed_cls = shed_classes(
+                    ds.config.autoscaling_config, snaps, ds.target
+                )
+                if shed != ds.shed or shed_cls != ds.shed_classes:
                     with self._lock:
                         ds.shed = shed
+                        ds.shed_classes = shed_cls
                     self._checkpoint("shed_flip")
                     changed = True
             else:
@@ -1338,6 +1352,7 @@ class ServeController:
                     # shed is persisted for inspection only; recovery
                     # recomputes it from fresh snapshots (see _recover)
                     "shed": ds.shed,
+                    "shed_classes": list(ds.shed_classes),
                     "signal_capable": ds.signal_capable,
                     "drain_capable": ds.drain_capable,
                     "batch_configs": ds.batch_configs,

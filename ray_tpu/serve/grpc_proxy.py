@@ -17,6 +17,10 @@ Request bytes are a JSON payload (or raw bytes if not JSON). Routing
 metadata keys (matching the reference's proxy metadata contract):
   "application" — app name (default "default")
   "method"      — deployment method (default "__call__")
+  "x-ray-tpu-priority" — LLM scheduling class ("interactive" |
+                  "default" | "batch"), injected into dict payloads as
+                  ``priority`` (docs/SERVING_LLM.md "Priority &
+                  preemption")
 Response chunks: bytes pass through raw; any other value is JSON-encoded.
 """
 from __future__ import annotations
@@ -35,7 +39,12 @@ from ray_tpu.exceptions import (
     RequestCancelledError,
     TaskError,
 )
-from ray_tpu.serve.proxy import TRACE_HEADER, TRACE_ID_HEADER, log_access
+from ray_tpu.serve.proxy import (
+    PRIORITY_HEADER,
+    TRACE_HEADER,
+    TRACE_ID_HEADER,
+    log_access,
+)
 from ray_tpu.util import tracing
 
 logger = logging.getLogger("ray_tpu.serve.grpc")
@@ -69,10 +78,13 @@ def _unwrap(e: BaseException) -> BaseException:
     return e
 
 
-def _code_for(e: BaseException):
+def _code_for(e: BaseException, priority: str | None = None):
     """Degradation statuses (mirrors the HTTP proxy's _status_for):
     overload -> RESOURCE_EXHAUSTED (retryable), blown deadline ->
-    DEADLINE_EXCEEDED, cancelled -> CANCELLED, else INTERNAL."""
+    DEADLINE_EXCEEDED, cancelled -> CANCELLED, else INTERNAL. Overload
+    responses are counted per priority class (``priority`` comes from
+    the request's metadata/payload) so operators can see WHICH class is
+    being degraded — under class-aware shedding, batch sheds first."""
     import grpc
 
     from ray_tpu.util import metrics
@@ -81,9 +93,10 @@ def _code_for(e: BaseException):
     if isinstance(e, EngineOverloadedError):
         metrics.counter(
             "serve_requests_shed",
-            "Requests rejected with an overload status at a proxy",
-            tag_keys=("proxy",),
-        ).inc(tags={"proxy": "grpc"})
+            "Requests rejected with an overload status at a proxy, "
+            "by priority class",
+            tag_keys=("proxy", "priority"),
+        ).inc(tags={"proxy": "grpc", "priority": priority or "default"})
         return grpc.StatusCode.RESOURCE_EXHAUSTED
     if isinstance(e, DeadlineExceededError):
         return grpc.StatusCode.DEADLINE_EXCEEDED
@@ -147,6 +160,7 @@ class GrpcProxy:
         from ray_tpu.serve.handle import DeploymentHandle
 
         app_name, method = self._target(context)
+        md = {k: v for k, v in (context.invocation_metadata() or ())}
         ingress = self._ingress_for(app_name)
         handle = DeploymentHandle(ingress, app_name).options(
             stream_chunk_timeout_s=self.options.request_timeout_s)
@@ -160,6 +174,11 @@ class GrpcProxy:
             if streaming:
                 payload = dict(payload)
                 payload.setdefault("request_id", uuid.uuid4().hex)
+                # priority class rides the metadata (payload key wins);
+                # class-aware shedding + per-class overload accounting
+                # key on it
+                if PRIORITY_HEADER in md:
+                    payload.setdefault("priority", md[PRIORITY_HEADER])
                 rid = payload["request_id"]
                 if state is not None:
                     state["request_id"] = rid
@@ -169,6 +188,9 @@ class GrpcProxy:
                         target=lambda: handle.broadcast("cancel", rid),
                         daemon=True, name="serve-grpc-cancel",
                     ).start()
+
+            if state is not None and payload.get("priority"):
+                state["priority"] = str(payload["priority"])
 
         if method == "__call__":
             return handle.remote(payload), cancel
@@ -237,7 +259,7 @@ class GrpcProxy:
                        status="NOT_FOUND", error=str(e))
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except Exception as e:  # noqa: BLE001 — surface to the client
-            code = _code_for(e)
+            code = _code_for(e, state.get("priority"))
             log_access("grpc", CALL_METHOD, state,
                        status=code.name, error=str(e))
             context.abort(code, str(e))
@@ -267,7 +289,7 @@ class GrpcProxy:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             return
         except Exception as e:  # noqa: BLE001
-            code = _code_for(e)
+            code = _code_for(e, state.get("priority"))
             log_access("grpc", STREAM_METHOD, state,
                        status=code.name, error=str(e))
             context.abort(code, str(e))
@@ -299,7 +321,7 @@ class GrpcProxy:
             log_access("grpc", STREAM_METHOD, state, status="OK")
         except Exception as e:  # noqa: BLE001
             finished.set()
-            code = _code_for(e)
+            code = _code_for(e, state.get("priority"))
             log_access("grpc", STREAM_METHOD, state,
                        status=code.name, error=str(e))
             context.abort(code, str(e))

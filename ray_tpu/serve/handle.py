@@ -329,10 +329,17 @@ class _Router:
         # dispatches then fail fast with EngineOverloadedError instead of
         # queuing doomed work (proxies map it to 503 + Retry-After)
         self._shed = False
+        # class-aware partial shed: when preemption is exhausted fleet-wide
+        # but capacity remains for higher classes, the controller names the
+        # priority classes to reject (batch first) instead of flipping the
+        # whole-deployment shed bit — docs/SERVING_LLM.md "Priority &
+        # preemption"
+        self._shed_classes: tuple = ()
         self._m_shed = metrics.counter(
             "llm_requests_shed",
-            "Requests shed at admission while the fleet is saturated",
-            tag_keys=("app", "deployment"),
+            "Requests shed at admission while the fleet is saturated, "
+            "by priority class",
+            tag_keys=("app", "deployment", "priority"),
         )
         # Seeded tie-break RNG: routers replay identical choice sequences
         # under the chaos harness (module-level random would interleave
@@ -423,6 +430,7 @@ class _Router:
             self._stream_methods = set(dep.get("stream_methods", ()))
             self._max_ongoing = dep["max_ongoing_requests"]
             self._shed = bool(dep.get("shed", False))
+            self._shed_classes = tuple(dep.get("shed_classes", ()))
             self._prefix_summaries = {
                 aid: frozenset(digests)
                 for aid, digests in (dep.get("prefix_summaries") or {}).items()
@@ -606,22 +614,35 @@ class _Router:
         with self._lock:
             is_stream = method_name in self._stream_methods
             shed = self._shed
-            if shed and time.monotonic() - self._table_at > _SHED_MAX_AGE_S:
+            shed_classes = self._shed_classes
+            if ((shed or shed_classes)
+                    and time.monotonic() - self._table_at > _SHED_MAX_AGE_S):
                 # stale flag during a controller outage: age it out and
                 # fail open — the saturated engines still shed for
                 # themselves, but an unreachable controller must not keep
                 # rejecting traffic it can no longer observe
                 shed = self._shed = False
-        if shed and not exclude and (is_stream or method_name == "__call__"):
+                shed_classes = self._shed_classes = ()
+        req_priority = "default"
+        if args and isinstance(args[0], dict):
+            req_priority = str(args[0].get("priority", "default"))
+        shed_this = shed or req_priority in shed_classes
+        if shed_this and not exclude and (is_stream or method_name == "__call__"):
             # fleet-wide saturation: reject NEW data-plane work before it
-            # queues (control methods — cancel, stats, debug — still pass;
-            # failover resumes carry ``exclude`` and are never shed so a
-            # half-delivered stream always finishes)
+            # queues — either the whole deployment (shed) or just the named
+            # priority classes once preemption is exhausted (shed_classes;
+            # batch first). Control methods — cancel, stats, debug — still
+            # pass; failover resumes carry ``exclude`` and are never shed
+            # so a half-delivered stream always finishes.
             self._m_shed.inc(tags={"app": self.app_name,
-                                   "deployment": self.deployment_name})
+                                   "deployment": self.deployment_name,
+                                   "priority": req_priority})
+            detail = ("all replicas saturated (queue backlog + KV pressure "
+                      "on every replica)" if shed else
+                      f"preemption exhausted fleet-wide; class "
+                      f"{req_priority!r} is being shed")
             raise EngineOverloadedError(
-                f"{self.app_name}/{self.deployment_name}: all replicas "
-                "saturated (queue backlog + KV pressure on every replica); "
+                f"{self.app_name}/{self.deployment_name}: {detail}; "
                 "shedding at admission — retry later"
             )
         # prefix-aware placement applies to fresh generation dispatches

@@ -140,7 +140,12 @@ class LLMDeployment:
 
         payload: {"prompt": str | [int], "max_new_tokens"?, "temperature"?,
         "top_k"?, "top_p"?, "seed"?, "request_id"?, "deadline_s"?,
-        "prior_tokens"?, "response_format"?, "stop"?}.
+        "prior_tokens"?, "response_format"?, "stop"?, "priority"?}.
+        ``priority`` is the scheduling class ("interactive" | "default" |
+        "batch" — docs/SERVING_LLM.md "Priority & preemption"); the
+        proxies inject it from the ``x-ray-tpu-priority`` header/metadata
+        key. It orders preemption and class-aware shedding and never
+        changes tokens.
         ``response_format`` selects grammar-constrained decoding
         (serve/llm/structured.py): ``"json"``/``"json_object"`` or an
         OpenAI-shaped dict ({"type": "json_schema", "schema": ...} /
@@ -200,6 +205,7 @@ class LLMDeployment:
             start_index=len(prior),
             structured=payload.get("response_format"),
             stop=tuple(stop),
+            priority=str(payload.get("priority", "default")),
         )
         # the replica method runs inside a task_span when the caller was
         # traced — hand that context to the engine so its phase spans join

@@ -140,6 +140,12 @@ def _window_rate(clocks: deque, now: float) -> float:
 # bugs and unit mistakes at construction time, where the field is named
 _MAX_NEW_TOKENS_CAP = 1 << 20
 
+# Priority classes, lowest rank first. Preemption pauses low-rank running
+# streams to make room for high-rank waiting ones; shedding degrades in
+# the same order (batch sheds before default sheds before interactive).
+_PRIORITIES = ("batch", "default", "interactive")
+PRIORITY_RANK = {name: rank for rank, name in enumerate(_PRIORITIES)}
+
 
 @dataclass(frozen=True)
 class SamplingParams:
@@ -157,8 +163,17 @@ class SamplingParams:
     # they appear as a suffix of the generated tokens (the matched stop
     # tokens ARE emitted, like EOS). Normalized to a tuple of tuples.
     stop: Any = ()
+    # priority class: "interactive" | "default" | "batch". Orders both
+    # preemption (batch pauses first) and class-aware shedding. Never
+    # changes tokens — only scheduling order.
+    priority: str = "default"
 
     def __post_init__(self):
+        if self.priority not in _PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {_PRIORITIES}, "
+                f"got {self.priority!r}"
+            )
         if not (1 <= self.max_new_tokens <= _MAX_NEW_TOKENS_CAP):
             raise ValueError(
                 f"max_new_tokens must be in [1, {_MAX_NEW_TOKENS_CAP}], "
@@ -263,6 +278,30 @@ class EngineConfig:
     # speculative step degenerates to a 1-token verify). Only consulted
     # when speculative_k > 0.
     drafter: Any = "ngram"
+    # ---- priority preemption (None disables) ----
+    # PreemptionConfig (or a dict of its fields). When set, the scheduler
+    # may PAUSE the lowest-priority running streams under KV-pool pressure
+    # or queue-wait pressure: their full KV block chains demote through
+    # the host tier funnel, the request parks in a "preempted" lifecycle
+    # state with cursor/timeline/FSM intact, and it resumes automatically
+    # (byte-identical, by keyed (seed, position) sampling) when pressure
+    # clears or the starvation-aging floor trips.
+    preemption: Any = None
+
+
+@dataclass(frozen=True)
+class PreemptionConfig:
+    """Thresholds for priority preemption (EngineConfig.preemption).
+
+    Pressure is the fraction of usable KV blocks in use (reservations
+    included); all times are engine-clock seconds (obs.clock)."""
+
+    kv_pressure: float = 0.90   # pause when pool pressure crosses this
+    queue_wait_s: float = 0.25  # ... or a higher-priority wait exceeds this
+    resume_pressure: float = 0.75  # resume parked streams below this
+    aging_s: float = 30.0       # starvation floor: waiting/parked this long
+    # is boosted above interactive and becomes non-preemptible
+    max_preempted: int = 64     # cap on concurrently parked streams
 
 
 class TokenStream:
@@ -306,6 +345,10 @@ class _Request:
         # request, and a stored trace context turns it into spans on finish
         "trace_ctx", "timeline", "submitted_clock", "first_token_clock",
         "last_token_clock", "finish_reason",
+        # priority preemption: when paused, the full token chain
+        # (prompt + generated) to re-prefill on resume; the park
+        # timestamp; how many times this stream has been paused
+        "pending_resume", "preempted_clock", "preempt_count",
     )
 
     def __init__(self, req_id, prompt, sampling: SamplingParams,
@@ -341,6 +384,9 @@ class _Request:
         self.table_key: tuple | None = None      # (nb, table_version)
         self.fsm = None  # structured.FSMCursor when grammar-constrained
         self.done = False
+        self.pending_resume: list[int] | None = None
+        self.preempted_clock: float | None = None
+        self.preempt_count = 0
         self.deadline = (
             time.monotonic() + sampling.deadline_s
             if sampling.deadline_s is not None
@@ -350,6 +396,13 @@ class _Request:
     @property
     def total_len(self) -> int:
         return len(self.prompt) + len(self.generated)
+
+    @property
+    def prefill_tokens(self) -> list[int]:
+        """The token chain prefill must make KV-resident: the prompt, or
+        prompt + generated-so-far when resuming from preemption."""
+        return (self.pending_resume if self.pending_resume is not None
+                else self.prompt)
 
 
 @dataclass
@@ -476,6 +529,21 @@ class LLMEngine:
         self._waiting_blocks = 0  # worst-case blocks held by the queue
         self._prefilling: list[_Request] = []  # admitted, prefill incomplete
         self._running: list[_Request] = []
+        # ---- priority preemption (ISSUE 17) ----
+        if isinstance(cfg.preemption, dict):
+            self._preemption: PreemptionConfig | None = PreemptionConfig(
+                **cfg.preemption
+            )
+        else:
+            self._preemption = cfg.preemption
+        # paused streams: zero KV blocks held, cursor/timeline/FSM intact,
+        # token chain re-prefills (host tier serving the hashed full
+        # blocks) when pressure clears
+        self._preempted: list[_Request] = []
+        self._preempted_total = 0
+        # True while pressure holds AND no lower-priority victim remains —
+        # the point where per-class shedding (autoscaling_policy) kicks in
+        self._preempt_exhausted = False
         self._next_id = 0
         self._auto_step = auto_step
         self._thread: threading.Thread | None = None
@@ -642,6 +710,21 @@ class LLMEngine:
             "Fraction of the usable KV pool a new admission cannot claim "
             "(allocations + reservations + quarantine)",
         )
+        # priority preemption (ISSUE 17)
+        self._m_preemptions = metrics.counter(
+            "llm_preemptions_total",
+            "Running streams paused to the host KV tier to make room for "
+            "higher-priority work",
+        )
+        self._m_preempted_streams = metrics.gauge(
+            "llm_preempted_streams",
+            "Streams currently parked in the preempted state",
+        )
+        self._m_preempted_wait = metrics.histogram(
+            "llm_preempted_wait_seconds",
+            "Seconds a preempted stream spent parked before resuming",
+            boundaries=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0),
+        )
         # ---- serving goodput / MFU accounting (ISSUE 13) ----
         # Analytic forward FLOPs per token: 2 FLOPs per weight
         # (multiply+accumulate), the serving-side counterpart of the
@@ -786,6 +869,9 @@ class LLMEngine:
             try:
                 chaos.fire("engine.step")
                 self._step_expired = self._expire_deadlines_locked()
+                if self._preemption is not None:
+                    self._maybe_resume_locked()
+                    self._maybe_preempt_locked()
                 self._step_admitted = self._admit_locked()
                 # Fresh admissions prefill immediately (first token out the
                 # door); CONTINUING chunks of a long prompt alternate with
@@ -918,6 +1004,9 @@ class LLMEngine:
                 "waiting": len(self._waiting),
                 "prefilling": len(self._prefilling),
                 "running": len(self._running),
+                "preempted": len(self._preempted),
+                "preemptions_total": self._preempted_total,
+                "preempt_exhausted": self._preempt_exhausted,
                 "kv_used_blocks": self.cache.used_blocks,
                 "kv_utilization": self.cache.utilization,
                 "kv_high_water_blocks": cs.high_water_blocks,
@@ -1019,11 +1108,9 @@ class LLMEngine:
         usable = max(1, cache.cfg.usable_blocks)
         snap = cache.debug_snapshot()
         # Pressure = the fraction of the usable pool a NEW admission
-        # cannot claim: live allocations, reservations, and quarantined
-        # blocks all count against it; LRU-cached prefix blocks do not
-        # (they are evictable on demand).
-        claimable = max(0, cache.available_blocks - snap["reserved_blocks"])
-        pressure = min(1.0, max(0.0, 1.0 - claimable / usable))
+        # cannot claim (see _kv_pressure_locked — the preemption trigger
+        # reads the identical number).
+        pressure = self._kv_pressure_locked()
         # Two-tier pressure: a pressured device pool backed by a warm
         # host tier is cheaper to miss into than one without (misses
         # promote instead of recomputing), so the host-resident block
@@ -1062,6 +1149,18 @@ class LLMEngine:
             ),
             "running": len(self._running),
             "prefilling": len(self._prefilling),
+            # per-class queue depth + preemption saturation: the
+            # controller's class-aware shed policy
+            # (autoscaling_policy.shed_classes) degrades batch traffic
+            # first, and only once preemption itself is exhausted
+            "queue_depth_by_class": {
+                p: sum(
+                    1 for r in self._waiting if r.sampling.priority == p
+                )
+                for p in _PRIORITIES
+            },
+            "preempted_streams": len(self._preempted),
+            "preempt_exhausted": self._preempt_exhausted,
             "failed": self._failed is not None,
         }
         self._m_as_queue.set(out["queue_depth"])
@@ -1108,7 +1207,8 @@ class LLMEngine:
                            path=dump if isinstance(dump, str) else None)
             self._stopped = True
             err = RequestCancelledError("engine shut down")
-            for r in list(self._waiting) + self._prefilling + self._running:
+            for r in (list(self._waiting) + self._prefilling
+                      + self._running + self._preempted):
                 if not r.done:
                     r.done = True
                     self._finish_obs_locked(r, "shutdown")
@@ -1120,6 +1220,8 @@ class LLMEngine:
             self._waiting_blocks = 0
             self._prefilling.clear()
             self._running.clear()
+            self._preempted.clear()
+            self._m_preempted_streams.set(0)
             self._m_queue.set(0)
             self._m_util.set(self.cache.utilization)
             self._work.notify_all()
@@ -1139,6 +1241,9 @@ class LLMEngine:
             if r.id == request_id:
                 return r
         for r in self._waiting:
+            if r.id == request_id:
+                return r
+        for r in self._preempted:
             if r.id == request_id:
                 return r
         return None
@@ -1171,6 +1276,12 @@ class LLMEngine:
                 self._prefilling.remove(r)
             r.done = True  # before release: an inflight row defers it
             self._release_blocks_locked(r)
+        elif r in self._preempted:
+            # parked streams hold ZERO blocks (released at preemption) —
+            # unparking is the whole eviction; the demoted chain stays
+            # behind as an ordinary cache entry
+            self._preempted.remove(r)
+            self._m_preempted_streams.set(len(self._preempted))
         else:
             try:
                 self._waiting.remove(r)
@@ -1191,6 +1302,7 @@ class LLMEngine:
         for r in [
             r
             for r in list(self._waiting) + self._prefilling + self._running
+            + self._preempted
             if r.deadline is not None and now >= r.deadline
         ]:
             self._evict_locked(r)
@@ -1209,6 +1321,176 @@ class LLMEngine:
             r.out.put(_DONE)
         return expired
 
+    # ---------------- priority preemption (ISSUE 17) ----------------
+
+    def _kv_pressure_locked(self) -> float:
+        """Fraction of the usable KV pool a new admission cannot claim:
+        live allocations, reservations, and quarantined blocks count
+        against it; LRU-cached prefix blocks do not (evictable on
+        demand). The same definition ``autoscaling_snapshot`` exports as
+        ``kv_pool_pressure`` — preemption triggers and the autoscaler
+        read one number."""
+        cache = self.cache
+        usable = max(1, cache.cfg.usable_blocks)
+        claimable = max(0, cache.available_blocks - cache.reserved_blocks)
+        return min(1.0, max(0.0, 1.0 - claimable / usable))
+
+    def _rank_locked(self, r: _Request, now: float) -> int:
+        """Effective priority rank of a request at ``now`` (obs.clock):
+        the class rank (batch < default < interactive), boosted ABOVE
+        interactive once the request has waited or sat parked past the
+        starvation-aging floor. The boost is double-duty: an aged waiter
+        outranks every class for admission ordering, and an aged (or
+        once-parked-long-enough) running stream stops being preemptible —
+        together they guarantee batch traffic always finishes."""
+        pc = self._preemption
+        rank = PRIORITY_RANK[r.sampling.priority]
+        ref = (r.preempted_clock if r.preempted_clock is not None
+               else r.submitted_clock)
+        if pc is not None and ref is not None and now - ref >= pc.aging_s:
+            rank = len(_PRIORITIES)  # aged past every class
+        return rank
+
+    def _maybe_preempt_locked(self) -> None:
+        """Pause the lowest-priority RUNNING stream when KV-pool pressure
+        or a higher-priority waiter's queue age crosses the
+        PreemptionConfig thresholds. ONE victim per step: a preemption
+        frees a whole chain at once, and admission runs right after in
+        the same iteration, so pausing more per step would overshoot
+        before the freed headroom is even observed. While pressure holds
+        but no victim outranked by a waiter remains (or the parked set is
+        at its cap), ``_preempt_exhausted`` latches True — the signal
+        per-class shedding (autoscaling_policy.shed_classes) keys on."""
+        pc = self._preemption
+        if not self._waiting:
+            self._preempt_exhausted = False
+            return
+        now = obs.clock()
+        waiter = max(
+            self._waiting, key=lambda rq: self._rank_locked(rq, now)
+        )
+        w_rank = self._rank_locked(waiter, now)
+        pressured = (
+            self._kv_pressure_locked() >= pc.kv_pressure
+            or now - waiter.submitted_clock >= pc.queue_wait_s
+        )
+        if not pressured:
+            self._preempt_exhausted = False
+            return
+        victims = [
+            r for r in self._running
+            if self._rank_locked(r, now) < w_rank
+        ]
+        if not victims or len(self._preempted) >= pc.max_preempted:
+            self._preempt_exhausted = True
+            return
+        self._preempt_exhausted = False
+        # lowest class first; within a class the YOUNGEST stream pauses
+        # (oldest streams are closest to completion — finishing them
+        # releases their blocks for good)
+        victim = min(
+            victims,
+            key=lambda r: (self._rank_locked(r, now),
+                           -(r.submitted_clock or 0.0)),
+        )
+        self._preempt_one_locked(victim, now)
+
+    def _preempt_one_locked(self, r: _Request, now: float) -> bool:
+        """Pause one running stream: collapse the dispatch lag so nothing
+        in flight references its rows, content-address its resident
+        blocks in the prefix cache and demote them into the host tier
+        (insurance against device LRU eviction while parked), release
+        its allocation + leftover reservation exactly once, and park it
+        in the ``preempted`` state with cursor/timeline/FSM intact. On
+        resume the chain re-prefills — prefix hits serve the registered
+        blocks from the device LRU or promote them back through the
+        batched ``land_blocks`` scatter — and keyed (seed, position)
+        sampling reproduces the remaining tokens byte-identically."""
+        chaos.fire("llm.preempt", request=r.id,
+                   priority=r.sampling.priority)
+        if self._pending is not None:
+            # the victim (or a neighbor) may be in the dispatched step:
+            # reconcile first so its inflight count is 0 and the free
+            # below needs no quarantine. The victim may COMPLETE here —
+            # its lagged token was its last — in which case there is
+            # nothing left to pause.
+            self._reconcile_locked(self._pending)
+        if r.done or r not in self._running:
+            return False
+        chain = list(r.prompt) + list(r.generated)
+        # resident KV covers [0, total_len - 1): the last emitted
+        # token's K/V lands only when it is fed as the next decode input
+        resident = r.total_len - 1
+        if self.cfg.prefix_caching:
+            self.cache.register_prefix(r.id, chain, resident)
+        self.cache.demote_chain(chain, resident)
+        self._running.remove(r)
+        self._release_blocks_locked(r)
+        # back to the pre-admission shape (the resume is a plain
+        # re-admission of prompt + generated via pending_resume)
+        r.blocks_released = False
+        r.reserved_blocks = 0
+        r.drawn_blocks = 0
+        r.prefill_done = 0
+        r.cached_tokens = 0
+        r.started = False
+        r.skips = 0
+        r.table_np = None
+        r.table_key = None
+        r.pending_resume = chain
+        r.preempted_clock = now
+        r.preempt_count += 1
+        self._preempted.append(r)
+        self._preempted_total += 1
+        self._m_preemptions.inc()
+        self._m_preempted_streams.set(len(self._preempted))
+        self._m_util.set(self.cache.utilization)
+        self._tl(r, "preempted", generated=len(r.generated),
+                 priority=r.sampling.priority)
+        return True
+
+    def _maybe_resume_locked(self) -> None:
+        """Re-admit parked streams once pressure clears below
+        ``resume_pressure`` — or unconditionally once a stream's aging
+        floor trips (the starvation guarantee). Highest effective rank
+        first, oldest park first within a class; stops at the first
+        candidate that doesn't fit so resumes stay ordered. A resume is
+        a normal re-admission of the full token chain; the final prefill
+        chunk re-samples the next token at its true absolute position,
+        so the joined stream is byte-identical to an unpaused run."""
+        pc = self._preemption
+        if not self._preempted:
+            return
+        now = obs.clock()
+        while self._preempted:
+            cand = max(
+                self._preempted,
+                key=lambda r: (self._rank_locked(r, now),
+                               -(r.preempted_clock or 0.0)),
+            )
+            aged = now - cand.preempted_clock >= pc.aging_s
+            if not aged and self._kv_pressure_locked() > pc.resume_pressure:
+                break
+            if (len(self._running) + len(self._prefilling)
+                    >= self.cfg.max_batch_size):
+                break
+            if not self._try_admit_one_locked(cand):
+                break
+            self._preempted.remove(cand)
+            self._prefilling.append(cand)
+            parked = now - cand.preempted_clock
+            self._m_preempted_wait.observe(parked)
+            self._m_preempted_streams.set(len(self._preempted))
+            chaos.fire("llm.resume_preempted", request=cand.id,
+                       parked_s=parked)
+            self._tl(cand, "resumed",
+                     parked_ms=round(parked * 1000.0, 3),
+                     cached_tokens=cand.cached_tokens)
+            # preempted_clock deliberately stays set: the resumed stream
+            # keeps aging from its park time, so a stream that has
+            # already been paused once soon becomes non-preemptible
+            # (anti-thrash) via the _rank_locked boost
+
     def _try_admit_one_locked(self, req: _Request) -> bool:
         """Reserve worst-case blocks for one request, allocate its table,
         and map its resident prompt prefix. Returns False (no state
@@ -1220,12 +1502,16 @@ class LLMEngine:
         logits, and that write lands in a shared hashed block, so it
         always triggers exactly one copy-on-write copy."""
         bs = self.cfg.block_size
-        total = len(req.prompt) + req.sampling.max_new_tokens
+        # Resumed-from-preemption rows prefill prompt + generated-so-far,
+        # but the worst case is unchanged: len(toks) + tokens-still-to-
+        # generate == len(prompt) + max_new_tokens, always.
+        toks = req.prefill_tokens
+        total = len(toks) + (req.sampling.max_new_tokens - len(req.generated))
         need = self.cache.cfg.blocks_for(total)
         max_hit_blocks = None
         if self.cfg.prefix_caching:
-            hit_blocks = self.cache.peek_prefix(req.prompt)
-            if hit_blocks * bs >= len(req.prompt):  # full-prompt hit
+            hit_blocks = self.cache.peek_prefix(toks)
+            if hit_blocks * bs >= len(toks):  # full-chain hit
                 if (
                     need + 1 <= self.cache.cfg.usable_blocks
                     and self.cache.can_reserve(need + 1)
@@ -1249,12 +1535,12 @@ class LLMEngine:
         self.cache.allocate(req.id)
         if self.cfg.prefix_caching:
             hit_tokens = self.cache.assign_prefix(
-                req.id, req.prompt, max_blocks=max_hit_blocks
+                req.id, toks, max_blocks=max_hit_blocks
             )
             req.drawn_blocks += hit_tokens // bs
-            # a full-prompt hit still recomputes the LAST prompt token (a
-            # 1-token chunk) so the engine has logits to sample from
-            req.prefill_done = min(hit_tokens, len(req.prompt) - 1)
+            # a full-chain hit still recomputes the LAST token (a 1-token
+            # chunk) so the engine has logits to sample from
+            req.prefill_done = min(hit_tokens, len(toks) - 1)
             req.cached_tokens = req.prefill_done
         return True
 
@@ -1263,12 +1549,22 @@ class LLMEngine:
         the head's reservation doesn't fit, probe up to
         ``admission_probe`` requests behind it — unless the head has
         already been skipped ``admission_max_skips`` times, in which case
-        admission stalls until the head fits (no starvation). Returns the
-        number admitted this step."""
+        admission stalls until the head fits (no starvation). With
+        preemption enabled, candidates are ordered by effective priority
+        rank first (stable sort — FIFO within a class, and the starvation-
+        aging boost floats a starved request above interactive). Returns
+        the number admitted this step."""
         admitted = 0
         if not self._waiting:
             return 0
-        head = self._waiting[0]
+        if self._preemption is not None and len(self._waiting) > 1:
+            now = obs.clock()
+            order = sorted(
+                self._waiting, key=lambda rq: -self._rank_locked(rq, now)
+            )
+        else:
+            order = list(self._waiting)
+        head = order[0]
         probe_budget = (
             self.cfg.admission_probe
             if head.skips < self.cfg.admission_max_skips
@@ -1277,19 +1573,20 @@ class LLMEngine:
         probed = 0
         idx = 0
         while (
-            idx < len(self._waiting)
+            idx < len(order)
             and len(self._running) + len(self._prefilling)
             < self.cfg.max_batch_size
             and admitted < self.cfg.max_prefill_batch
         ):
-            req = self._waiting[idx]
+            req = order[idx]
             if self._try_admit_one_locked(req):
-                del self._waiting[idx]
+                self._waiting.remove(req)
                 self._waiting_blocks -= self.cache.cfg.blocks_for(
                     len(req.prompt) + req.sampling.max_new_tokens
                 )
                 self._prefilling.append(req)
                 admitted += 1
+                idx += 1
                 wait = obs.clock() - req.submitted_clock
                 self._m_queue_wait.observe(wait)
                 self._queue_wait_window.append(wait)
@@ -1363,7 +1660,7 @@ class LLMEngine:
         ns = []
         for r in batch:
             r.started = True
-            remaining = len(r.prompt) - r.prefill_done
+            remaining = len(r.prefill_tokens) - r.prefill_done
             ns.append(remaining if cap is None else min(remaining, cap))
         pairs: list[tuple[int, int]] = []
         for r, n in zip(batch, ns):
@@ -1377,7 +1674,7 @@ class LLMEngine:
         self._apply_copies_locked(pairs)
 
         legacy = all(
-            r.prefill_done == 0 and n == len(r.prompt)
+            r.prefill_done == 0 and n == len(r.prefill_tokens)
             for r, n in zip(batch, ns)
         )
         S = pad_to_bucket(max(ns), self._length_buckets)
@@ -1402,7 +1699,8 @@ class LLMEngine:
         starts[len(batch):] = 0
         tables[len(batch):] = 0
         for i, (r, n) in enumerate(zip(batch, ns)):
-            tokens[i, :n] = r.prompt[r.prefill_done : r.prefill_done + n]
+            toks = r.prefill_tokens
+            tokens[i, :n] = toks[r.prefill_done : r.prefill_done + n]
             tokens[i, n:] = 0
             lengths[i] = n
             starts[i] = r.prefill_done
@@ -1426,16 +1724,22 @@ class LLMEngine:
         dt = obs.clock() - t0
         kind = "prefill" if legacy else "prefill_chunk"
         for i, (r, n) in enumerate(zip(batch, ns)):
+            toks = r.prefill_tokens
             r.prefill_done += n
             self._prefill_tokens_total += n
             self._tl(r, kind, ts=t0_wall, dur_ms=round(dt * 1000.0, 3),
                      tokens=n, prefill_done=r.prefill_done)
             if self.cfg.prefix_caching:
-                self.cache.register_prefix(r.id, r.prompt, r.prefill_done)
-            if r.prefill_done >= len(r.prompt):
+                self.cache.register_prefix(r.id, toks, r.prefill_done)
+            if r.prefill_done >= len(toks):
                 self._prefilling.remove(r)
+                # resume-from-preemption chains are fully resident again:
+                # from here the row decodes exactly like an unpaused one
+                r.pending_resume = None
                 # the model samples from last-VALID-token logits per row —
-                # for the final chunk that is the last prompt token
+                # for the final chunk that is the last prompt token (or,
+                # resuming, the last already-emitted token: the keyed
+                # sampler reproduces the next token byte-identically)
                 self._emit_token_locked(r, int(host[i]))
                 if not r.done:
                     self._running.append(r)
@@ -2224,7 +2528,8 @@ class LLMEngine:
             logger.warning("task-event flush on engine failure: %r", e)
 
     def _fan_out_failure(self, err: EngineDiedError) -> None:
-        for r in list(self._waiting) + self._prefilling + self._running:
+        for r in (list(self._waiting) + self._prefilling + self._running
+                  + self._preempted):
             if not r.done:
                 r.done = True
                 self._finish_obs_locked(r, "failed")
@@ -2234,6 +2539,8 @@ class LLMEngine:
         self._waiting_blocks = 0
         self._prefilling = []
         self._running = []
+        self._preempted = []
+        self._m_preempted_streams.set(0)
         self._pending = None  # in-flight step dies with the engine
         self.cache.release_all()
 
@@ -2275,6 +2582,7 @@ class LLMEngine:
                         and not self._waiting
                         and not self._prefilling
                         and not self._running
+                        and not self._preempted
                     ):
                         self._work.wait(timeout=0.05)
 
@@ -2302,6 +2610,7 @@ class LLMEngine:
                     self._dump("watchdog_timeout", lock_free=True)
                 for r in (
                     list(self._waiting) + self._prefilling + self._running
+                    + self._preempted
                 ):
                     if not r.done:
                         r.done = True

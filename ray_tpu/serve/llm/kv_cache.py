@@ -293,6 +293,13 @@ class PagedKVCache:
         evicting the freshly-landed payloads back out of the pool."""
         return max(0, self.available_blocks - self._reserved)
 
+    @property
+    def reserved_blocks(self) -> int:
+        """Outstanding admission reservations — the engine's preemption
+        trigger and the autoscaling snapshot subtract these from
+        ``available_blocks`` to get what a new admission can claim."""
+        return self._reserved
+
     def can_reserve(self, n_blocks: int) -> bool:
         return n_blocks <= self.available_blocks - self._reserved
 
@@ -662,6 +669,66 @@ class PagedKVCache:
             logger.warning(
                 "host-tier demotion of block %d failed: %r", block, exc
             )
+
+    def demote_chain(self, tokens, upto_tokens: int) -> int:
+        """Proactively back the leading full blocks of ``tokens`` (first
+        ``upto_tokens`` of them) into the host tier — the preemption
+        pause path (engine._preempt_one_locked): the paused stream's
+        chain must survive device LRU eviction while it is parked, so
+        its resume re-prefills from cache instead of recomputing. One
+        batched ``demote_fn`` export for all missing blocks (the same
+        engine-installed indirection ``_demote_evicted`` uses — the
+        cache never touches the device itself). Best-effort like every
+        demote: a failed capture costs recompute on resume, never
+        correctness, so failures are counted + logged, not raised.
+        Returns the number of blocks newly captured."""
+        tier = self.host_tier
+        if tier is None or self.demote_fn is None:
+            return 0
+        bs = self.cfg.block_size
+        digest = b""
+        todo: list[tuple[bytes, int]] = []
+        for i in range(min(upto_tokens, len(tokens)) // bs):
+            digest = _block_key(digest, tokens[i * bs:(i + 1) * bs])
+            b = self._hash_to_block.get(digest)
+            if b is None:
+                break  # not registered (or already evicted): chain ends
+            if digest in tier:
+                tier.touch(digest)  # already backed: refresh recency
+                continue
+            if b in self._unlanded:
+                # device bytes are still garbage (promotion queued but
+                # not landed); the arena already holds the real content
+                continue
+            todo.append((digest, b))
+        if not todo:
+            return 0
+        from ray_tpu._private import chaos
+
+        captured = 0
+        try:
+            chaos.fire("llm.kv.demote", blocks=len(todo))
+            k, v = self.demote_fn([b for _, b in todo])
+            for i, (d, b) in enumerate(todo):
+                stored, evicted = tier.put(d, k[:, i], v[:, i])
+                if stored:
+                    captured += 1
+                    self.stats.demoted_blocks += 1
+                    self.stats.host_evicted_blocks += evicted
+                else:
+                    self.stats.demote_drops += 1
+                    logger.warning(
+                        "host tier refused preemption-demoted block %d "
+                        "(payload exceeds host_cache_bytes=%d)",
+                        b, tier.capacity_bytes,
+                    )
+        except Exception as exc:
+            self.stats.demote_drops += len(todo) - captured
+            logger.warning(
+                "host-tier chain demotion of %d blocks failed: %r",
+                len(todo), exc,
+            )
+        return captured
 
     def _host_lookup(self, digest: bytes):
         """Fetch + verify one host-tier entry; -> (k, v) numpy blocks or

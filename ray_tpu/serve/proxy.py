@@ -39,6 +39,16 @@ _access_logger = logging.getLogger("ray_tpu.serve.access")
 TRACE_HEADER = "x-ray-tpu-trace"
 TRACE_ID_HEADER = "x-ray-tpu-trace-id"
 
+# Request header (HTTP) / metadata key (gRPC) naming the LLM scheduling
+# class ("interactive" | "default" | "batch"); injected into dict payloads
+# as ``priority`` (docs/SERVING_LLM.md "Priority & preemption").
+PRIORITY_HEADER = "x-ray-tpu-priority"
+
+# Class-aware backoff hints: interactive retries fast (capacity opens as
+# soon as a stream completes), batch backs off hard (it is the first class
+# shed and the last resumed under sustained overload).
+_RETRY_AFTER = {"interactive": "1", "default": "2", "batch": "5"}
+
 
 def log_access(proxy: str, path: str, state: dict, *, status: str,
                error: str | None = None) -> None:
@@ -71,23 +81,29 @@ def _unwrap(e: BaseException) -> BaseException:
     return e
 
 
-def _status_for(e: BaseException) -> tuple[int, dict]:
+def _status_for(e: BaseException,
+                priority: str | None = None) -> tuple[int, dict]:
     """Map framework errors to HTTP degradation statuses: overload is
-    retryable (503 + Retry-After), a blown deadline is a gateway timeout
-    (504), a cancelled request is nginx's client-closed-request (499),
-    and a request-validation ValueError — including GrammarError for an
-    invalid or unsatisfiable response_format — is the client's fault
-    (400, never a 500/failover)."""
+    retryable (503 + Retry-After, with a class-aware backoff hint and a
+    per-priority shed counter — under class-aware shedding batch is
+    rejected first, so operators can see WHICH class is degraded), a
+    blown deadline is a gateway timeout (504), a cancelled request is
+    nginx's client-closed-request (499), and a request-validation
+    ValueError — including GrammarError for an invalid or unsatisfiable
+    response_format — is the client's fault (400, never a 500/failover).
+    """
     from ray_tpu.util import metrics
 
     e = _unwrap(e)
     if isinstance(e, EngineOverloadedError):
+        pc = priority or "default"
         metrics.counter(
             "serve_requests_shed",
-            "Requests rejected with an overload status at a proxy",
-            tag_keys=("proxy",),
-        ).inc(tags={"proxy": "http"})
-        return 503, {"Retry-After": "1"}
+            "Requests rejected with an overload status at a proxy, "
+            "by priority class",
+            tag_keys=("proxy", "priority"),
+        ).inc(tags={"proxy": "http", "priority": pc})
+        return 503, {"Retry-After": _RETRY_AFTER.get(pc, "2")}
     if isinstance(e, DeadlineExceededError):
         return 504, {}
     if isinstance(e, RequestCancelledError):
@@ -313,6 +329,7 @@ class HTTPProxy:
             # and deadline errors map to a status code before the response
             # headers go out; remaining chunks are pumped by stream_response.
             traced = TRACE_HEADER in request.headers
+            prio_header = request.headers.get(PRIORITY_HEADER)
             state: dict[str, Any] = {"t0": time.perf_counter()}
 
             def call_blocking():
@@ -341,8 +358,15 @@ class HTTPProxy:
                             # cancel it on whichever replica is serving it
                             payload = dict(payload)
                             payload.setdefault("request_id", uuid.uuid4().hex)
+                            # priority class rides the header (payload key
+                            # wins); class-aware shedding and per-class
+                            # overload accounting key on it
+                            if prio_header:
+                                payload.setdefault("priority", prio_header)
                             state["request_id"] = payload["request_id"]
                             state["handle"] = handle
+                        if payload.get("priority"):
+                            state["priority"] = str(payload["priority"])
                     response = handle.remote(payload)
                     if isinstance(response, DeploymentResponseGenerator):
                         it = iter(response)
@@ -361,7 +385,7 @@ class HTTPProxy:
                     None, call_blocking
                 )
             except Exception as e:  # noqa: BLE001 — surface to the client
-                status, headers = _status_for(e)
+                status, headers = _status_for(e, state.get("priority"))
                 log_access("http", request.path, state,
                            status=str(status), error=str(e))
                 return web.json_response(

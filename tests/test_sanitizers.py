@@ -282,7 +282,14 @@ def test_handoff_retry_paths_never_swallow_silently():
     GrammarError — swallowed, the request would silently run
     UNCONSTRAINED — and an FSM-advance failure (engine.py
     ``_advance_fsm_locked``) terminates the stream early, which is
-    only diagnosable if the rejection is logged."""
+    only diagnosable if the rejection is logged.
+
+    Priority preemption (ISSUE 17) adds the pause/resume paths: a
+    demote failure in ``demote_chain`` means a parked stream resumes
+    by recompute instead of host-tier promote (correct but slow — must
+    be counted and logged), and an error swallowed inside
+    ``_preempt_one_locked`` / ``_maybe_resume_locked`` could strand a
+    stream in ``preempted`` forever with blocks half-released."""
     import ast
     import pathlib
 
@@ -301,7 +308,7 @@ def test_handoff_retry_paths_never_swallow_silently():
             "_prompt_digests",
         }),
         root / "ray_tpu" / "serve" / "llm" / "kv_cache.py": frozenset({
-            "_demote_evicted", "_host_lookup",
+            "_demote_evicted", "_host_lookup", "demote_chain",
         }),
         root / "ray_tpu" / "serve" / "controller.py": frozenset({
             "_recover", "_checkpoint", "_adopt_replica",
@@ -311,7 +318,8 @@ def test_handoff_retry_paths_never_swallow_silently():
             "compile_grammar",
         }),
         root / "ray_tpu" / "serve" / "llm" / "engine.py": frozenset({
-            "_advance_fsm_locked",
+            "_advance_fsm_locked", "_preempt_one_locked",
+            "_maybe_resume_locked",
         }),
     }
     offenders = []
@@ -364,7 +372,11 @@ def test_one_clock_in_llm_serving_path():
     ``time.time()`` or ``time.perf_counter()`` elsewhere in the engine
     produces step records, histograms, and timelines that disagree about
     what was measured. ``time.monotonic``/``time.sleep`` stay allowed
-    (deadline math and the watchdog poll are not measurements)."""
+    (deadline math and the watchdog poll are not measurements). The
+    preemption scheduler (ISSUE 17) raises the stakes: queue-wait
+    pressure, starvation aging, and parked-time histograms all compare
+    engine-stamped clocks — a second clock source would make an aged
+    request look young (or vice versa) and break the starvation floor."""
     import ast
     import pathlib
 

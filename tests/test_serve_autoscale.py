@@ -518,7 +518,9 @@ def test_saturated_fleet_sheds_503_with_retry_after(as_cluster):
 
         assert _wait_for(proxy_503, timeout_s=30, interval=0.2), \
             "HTTP proxy never returned 503 while the fleet shed"
-        assert retry_after[-1] == "1"
+        # class-aware backoff (PR 17): an un-prioritized request is the
+        # "default" class, whose Retry-After is 2 s
+        assert retry_after[-1] == "2"
     finally:
         stop_feeding.set()
     for t in feeders:

@@ -368,7 +368,9 @@ def test_overload_degrades_to_503_and_resource_exhausted(ft_cluster):
     with pytest.raises(urllib.error.HTTPError) as http_err:
         urllib.request.urlopen(req, timeout=60)
     assert http_err.value.code == 503
-    assert http_err.value.headers["Retry-After"] == "1"
+    # class-aware backoff (PR 17): an un-prioritized request is the
+    # "default" class, whose Retry-After is 2 s
+    assert http_err.value.headers["Retry-After"] == "2"
 
     # gRPC: overload -> RESOURCE_EXHAUSTED
     ch = grpc.insecure_channel(f"127.0.0.1:{serve.grpc_port()}")
