@@ -64,6 +64,19 @@ Reported (one JSON line, merged into bench.py's aux results under
                               RAY_TPU_PAGED_ATTN_SHAPE), and a second
                               GQA-heavy point reports under
                               ``llm_paged_attn_gqa_*``
+- ``llm_paged_prefill_xla_ms`` / ``llm_paged_prefill_pallas_ms``
+                              prefill attention in isolation: one jitted
+                              ``prefill_attention`` call per backend at a
+                              chunk-over-paged-context shape (shuffled
+                              tables, ragged true starts), median wall
+                              ms; ``llm_paged_prefill_window_xla_ms`` /
+                              ``llm_paged_prefill_window_pallas_ms``
+                              re-time the pair with a sliding window
+                              (the pallas kernel skips kv-blocks below
+                              the window floor);
+                              ``llm_paged_prefill_shape`` records the
+                              shape measured (env-overridable via
+                              RAY_TPU_PAGED_PREFILL_SHAPE)
 
 - ``llm_load_ttft_p99_ms`` / ``llm_load_tpot_p99_ms`` /
   ``llm_load_shed_rate``     the chaos load harness (``run_load_bench``):
@@ -78,13 +91,19 @@ Reported (one JSON line, merged into bench.py's aux results under
                               accepted stream matched an unfaulted
                               local reference byte-for-byte (zero
                               dropped or duplicated tokens through
-                              kill + drain); the bimodal prompt mix
-                              also reports
+                              kill + drain); the trimodal prompt mix
+                              (short chat turns, long documents, and a
+                              book-length sliver near the context
+                              ceiling) also reports
                               ``llm_load_decode_tpot_p99_ms_short`` /
                               ``_long`` — decode TPOT per prompt class,
                               the number disaggregated prefill
                               (``run_load_bench(prefill_replicas=1)``)
-                              is judged on; a LOAD_JSON_FRACTION
+                              is judged on — plus
+                              ``llm_load_long_ttft_p99_ms`` (book + long
+                              TTFT p99, the fleet-level number the fused
+                              paged-prefill kernel moves); a
+                              LOAD_JSON_FRACTION
                               minority of requests runs grammar-
                               constrained (``response_format="json"``)
                               and reports ``llm_load_json_requests`` /
@@ -164,6 +183,16 @@ PAGED_ATTN_GQA_SHAPE = (8, 16, 2, 64)
 PAGED_ATTN_BLOCK = 16
 PAGED_ATTN_NBLOCKS = 8
 PAGED_ATTN_ITERS = 20
+# prefill-attention microbench (ISSUE 18): default [B, S, Hq, Hkv, hd]
+# chunk shape over a bs x NB paged pool — a chunk of S queries at ragged
+# true starts attending over T = bs*NB cached tokens, the chunked-prefill
+# regime the fused prefill kernel targets. Override with
+# RAY_TPU_PAGED_PREFILL_SHAPE="B,S,Hq,Hkv,hd" (or x-separated). The
+# sliding-window point re-times the pallas/xla pair at PAGED_PREFILL_WINDOW.
+PAGED_PREFILL_SHAPE = (2, 64, 4, 2, 64)
+PAGED_PREFILL_BLOCK = 16
+PAGED_PREFILL_NBLOCKS = 16
+PAGED_PREFILL_WINDOW = 32
 # speculative-decoding phase: draft window and generation budget sized so
 # the n-gram drafter locks onto the repeating motif within the run
 SPEC_K = 4
@@ -182,13 +211,21 @@ LOAD_BURST_GAP_S = 6.0
 LOAD_DRAIN_AT_S = 11.0   # scale_deployment -> 1 (graceful drain) offset
 LOAD_NEW_TOKENS = 12
 LOAD_KILL_INDEX = 2      # chunk index after which the tagged replica dies
-# Bimodal prompt mix (the disaggregation workload): mostly short chat
-# turns plus a long-document minority whose monolithic prefills are
-# exactly what stalls co-located decoders. Decode TPOT is reported per
-# class so the long-prefill interference on SHORT streams is visible.
+# Prompt mix (the disaggregation workload): mostly short chat turns plus
+# a long-document minority whose monolithic prefills are exactly what
+# stalls co-located decoders. Decode TPOT is reported per class so the
+# long-prefill interference on SHORT streams is visible.
 LOAD_LONG_FRACTION = 0.3
 LOAD_SHORT_PROMPT = (3, 9)    # uniform token-count range, inclusive-lo
 LOAD_LONG_PROMPT = (48, 81)
+# Book-length bucket (ISSUE 18): a small third mode near the model's
+# context ceiling — the tiny-config stand-in for the ~32k-token prompts
+# long-context serving is sized for (max_seq_len 128 here, so ~100 tokens
+# plays the part 32k plays at production scale). Their TTFT p99 reports as
+# ``llm_load_long_ttft_p99_ms`` (book + long classes pooled), the fleet-
+# level number the fused paged-prefill kernel is judged on.
+LOAD_BOOK_FRACTION = 0.15
+LOAD_BOOK_PROMPT = (96, 105)
 # fraction of load requests carrying response_format="json" (grammar-
 # constrained): exercises the allow-mask path under mixed bursty traffic
 # and through the mid-stream kill — constrained streams ride the same
@@ -569,6 +606,108 @@ def run_paged_attn_microbench(
     return out
 
 
+def _paged_prefill_env_shape() -> tuple[int, int, int, int, int] | None:
+    """Parse RAY_TPU_PAGED_PREFILL_SHAPE ("B,S,Hq,Hkv,hd"; ',' or 'x'
+    separated), the prefill twin of RAY_TPU_PAGED_ATTN_SHAPE. Returns None
+    when unset; raises on malformed values so a typo'd override fails
+    loudly instead of silently benching the default shape."""
+    raw = os.environ.get("RAY_TPU_PAGED_PREFILL_SHAPE", "").strip()
+    if not raw:
+        return None
+    parts = [p for p in raw.replace("x", ",").split(",") if p.strip()]
+    if len(parts) != 5:
+        raise ValueError(
+            f"RAY_TPU_PAGED_PREFILL_SHAPE must be 5 ints (B,S,Hq,Hkv,hd), "
+            f"got {raw!r}"
+        )
+    return tuple(int(p) for p in parts)  # type: ignore[return-value]
+
+
+def run_paged_prefill_microbench(
+    shape: tuple[int, int, int, int, int] | None = None,
+    *,
+    block_size: int | None = None,
+    num_blocks: int | None = None,
+    window: int | None = None,
+    prefix: str = "llm_paged_prefill",
+) -> dict:
+    """Prefill attention isolated from the engine (ISSUE 18): one jitted
+    ``prefill_attention`` per backend at a fixed chunk-over-context shape,
+    median wall ms over ``PAGED_ATTN_ITERS`` calls — then the same pair
+    again with a sliding window, where the pallas kernel additionally
+    skips kv-blocks below the window floor. Shuffled block tables +
+    ragged true chunk starts so both paths pay realistic gather/walk
+    patterns (emitted keys: ``llm_paged_prefill_xla_ms`` /
+    ``llm_paged_prefill_pallas_ms`` and
+    ``llm_paged_prefill_window_xla_ms`` /
+    ``llm_paged_prefill_window_pallas_ms``). The backends share inputs; a
+    byte-comparison here would be redundant with
+    tests/test_paged_attention.py — this phase only times.
+
+    ``shape`` is [B, S, Hq, Hkv, hd]; when None the
+    RAY_TPU_PAGED_PREFILL_SHAPE env override applies, then
+    ``PAGED_PREFILL_SHAPE``."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.paged_attention import prefill_attention
+
+    if shape is None:
+        shape = _paged_prefill_env_shape() or PAGED_PREFILL_SHAPE
+    B, S, Hq, Hkv, hd = shape
+    bs = PAGED_PREFILL_BLOCK if block_size is None else block_size
+    NB = PAGED_PREFILL_NBLOCKS if num_blocks is None else num_blocks
+    w = PAGED_PREFILL_WINDOW if window is None else window
+    T = bs * NB
+    key = jax.random.PRNGKey(43)
+    rng = np.random.default_rng(43)
+    num_blocks = 1 + B * NB
+    k_layer = jax.random.normal(
+        jax.random.fold_in(key, 0), (num_blocks, bs, Hkv, hd), jnp.float32
+    )
+    v_layer = jax.random.normal(
+        jax.random.fold_in(key, 1), (num_blocks, bs, Hkv, hd), jnp.float32
+    )
+    q = jax.random.normal(
+        jax.random.fold_in(key, 2), (B, S, Hq, hd), jnp.float32
+    )
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, num_blocks)).reshape(B, NB), jnp.int32
+    )
+    # ragged true starts: each row's chunk lands somewhere inside its
+    # cached context, the chunked-prefill / prefix-cache-hit regime
+    starts = rng.integers(0, T - S + 1, size=B)
+    positions = jnp.asarray(
+        starts[:, None] + np.arange(S)[None, :], jnp.int32
+    )
+
+    out: dict = {
+        f"{prefix}_shape": {
+            "B": B, "S": S, "Hq": Hq, "Hkv": Hkv, "hd": hd,
+            "block_size": bs, "T": T, "window": w,
+        }
+    }
+    for suffix, win in (("", None), ("_window", w)):
+        for backend in ("xla", "pallas"):
+            fn = jax.jit(
+                lambda q, k, v, t, p, _b=backend, _w=win: prefill_attention(
+                    q, k, v, t, p, backend=_b, window=_w
+                )
+            )
+            fn(q, k_layer, v_layer, tables, positions).block_until_ready()
+            samples = []
+            for _ in range(PAGED_ATTN_ITERS):
+                t0 = time.perf_counter()
+                fn(q, k_layer, v_layer, tables, positions).block_until_ready()
+                samples.append(time.perf_counter() - t0)
+            out[f"{prefix}{suffix}_{backend}_ms"] = round(
+                float(np.percentile(samples, 50)) * 1e3, 3
+            )
+    return out
+
+
 def run_spec_decode_bench() -> dict:
     """Speculative decoding on a repeating-structure prompt: the same
     single-stream generation run twice — speculation off (the baseline)
@@ -736,11 +875,11 @@ def run_structured_bench() -> dict:
 
 def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
     """Seeded open-loop request schedule: (index, start offset s, payload)
-    per request. Bimodal prompt lengths (LOAD_LONG_FRACTION long-document
-    prompts amid short chat turns) and bursty arrivals; the first request
-    of the SECOND burst carries the chaos kill tag so the kill lands
-    while both the heavy first burst's stragglers and fresh work are in
-    flight. Each payload is marked with its ``prompt_class`` so the
+    per request. Trimodal prompt lengths (a LOAD_BOOK_FRACTION book-length
+    sliver and LOAD_LONG_FRACTION long-document prompts amid short chat
+    turns) and bursty arrivals; the first request of the SECOND burst
+    carries the chaos kill tag so the kill lands while both the heavy
+    first burst's stragglers and fresh work are in flight. Each payload is marked with its ``prompt_class`` so the
     harness can split decode-TPOT percentiles by class; a
     LOAD_JSON_FRACTION minority additionally carries
     ``response_format="json"`` so grammar-constrained and free-running
@@ -752,10 +891,17 @@ def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
     idx = 0
     for size in LOAD_BURSTS:
         for _ in range(size):
-            is_long = bool(rng.random() < LOAD_LONG_FRACTION)
+            # one draw splits the trimodal mix so class boundaries stay
+            # seeded: [0, book) book, [book, book+long) long, rest short
+            cls_draw = float(rng.random())
+            if cls_draw < LOAD_BOOK_FRACTION:
+                cls, (lo, hi) = "book", LOAD_BOOK_PROMPT
+            elif cls_draw < LOAD_BOOK_FRACTION + LOAD_LONG_FRACTION:
+                cls, (lo, hi) = "long", LOAD_LONG_PROMPT
+            else:
+                cls, (lo, hi) = "short", LOAD_SHORT_PROMPT
             is_json = bool(rng.random() < LOAD_JSON_FRACTION)
             is_batch = bool(rng.random() < LOAD_BATCH_FRACTION)
-            lo, hi = LOAD_LONG_PROMPT if is_long else LOAD_SHORT_PROMPT
             n = int(rng.integers(lo, hi))
             payload = {
                 "prompt": [int(x) for x in rng.integers(1, vocab_size, n)],
@@ -763,7 +909,7 @@ def _load_schedule(rng, vocab_size: int) -> list[tuple[int, float, dict]]:
                 "max_new_tokens": LOAD_NEW_TOKENS,
                 "temperature": 0.8,
                 "seed": 1000 + idx,
-                "prompt_class": "long" if is_long else "short",
+                "prompt_class": cls,
                 "priority": "batch" if is_batch else "interactive",
             }
             if is_json:
@@ -1334,10 +1480,14 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
     ttfts = [r["arrivals"][0] - r["dispatched"]
              for r in accepted if r["arrivals"]]
     ttfts_by_prio: dict[str, list[float]] = {}
+    ttfts_by_class: dict[str, list[float]] = {}
     for r in accepted:
         if r["arrivals"]:
             prio = r["payload"].get("priority", "default")
             ttfts_by_prio.setdefault(prio, []).append(
+                r["arrivals"][0] - r["dispatched"])
+            cls = r["payload"].get("prompt_class", "short")
+            ttfts_by_class.setdefault(cls, []).append(
                 r["arrivals"][0] - r["dispatched"])
     batch_total = sum(
         1 for r in results if r["payload"].get("priority") == "batch")
@@ -1429,6 +1579,15 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
             tpots_by_class.get("short", [])),
         "llm_load_decode_tpot_p99_ms_long": _p99_ms(
             tpots_by_class.get("long", [])),
+        # long-prompt TTFT (ISSUE 18): book + long classes pooled — the
+        # fleet-level number the fused paged-prefill kernel moves. The
+        # book sliver sits near the context ceiling, so its prefill cost
+        # dominates this tail.
+        "llm_load_long_ttft_p99_ms": _p99_ms(
+            ttfts_by_class.get("book", []) + ttfts_by_class.get("long", [])),
+        "llm_load_book_requests": sum(
+            1 for r in results
+            if r["payload"].get("prompt_class") == "book"),
         "llm_load_prefill_replicas": prefill_replicas,
         # mixed-class degradation report (ISSUE 17): interactive holds its
         # latency under saturation, batch waits but always completes
@@ -1474,6 +1633,7 @@ def main() -> None:
             PAGED_ATTN_GQA_SHAPE, prefix="llm_paged_attn_gqa"
         )
     )
+    out.update(run_paged_prefill_microbench())
     # cluster-lifecycle phases last: each owns a full ray_tpu
     # init/serve.run/shutdown cycle
     out.update(run_fleet_prefix_bench())

@@ -334,16 +334,19 @@ def gpt_prefill(
     jitted program and (sampled first tokens [B] int32, cache_k',
     cache_v') comes back instead — logits never leave the device.
 
-    ``start=None``: the whole prompt starts at position 0 and attention is
-    the XLA reference kernel over the chunk alone — prefill happens once
-    per request at bucketed shapes, where flash's grid setup buys nothing.
-    ``start`` [B] int32 (chunked prefill / prefix-cache hits): row b's
-    tokens sit at TRUE positions start[b].. and earlier positions are
+    ``start=None``: the whole prompt starts at position 0. Under the XLA
+    backend attention is the reference kernel over the chunk alone —
+    prefill happens once per request at bucketed shapes, where flash's
+    grid setup buys nothing; under pallas it runs the fused paged-prefill
+    kernel off the just-written cache (the padded context never exists in
+    HBM). ``start`` [B] int32 (chunked prefill / prefix-cache hits): row
+    b's tokens sit at TRUE positions start[b].. and earlier positions are
     already resident in the paged cache, so positional embeddings index
-    the true positions and attention gathers the full paged context
-    (``paged_prefill_attention``).
+    the true positions and attention covers the full paged context via
+    the ``prefill_attention`` backend dispatcher.
     """
-    from ray_tpu.ops.kv_cache import paged_prefill_attention, write_kv
+    from ray_tpu.ops.kv_cache import write_kv
+    from ray_tpu.ops.paged_attention import prefill_attention, resolve_backend
 
     B, S = tokens.shape
     D = cfg.d_model
@@ -369,7 +372,7 @@ def gpt_prefill(
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
         )
-        if start is None:
+        if start is None and resolve_backend(cfg.attention_backend) != "pallas":
             attn = mha_reference(
                 q.transpose(0, 2, 1, 3),
                 kk.transpose(0, 2, 1, 3),
@@ -377,9 +380,10 @@ def gpt_prefill(
                 causal=True,
             ).transpose(0, 2, 1, 3).reshape(B, S, D)
         else:
-            attn = paged_prefill_attention(
+            attn = prefill_attention(
                 q, k_layer, v_layer, block_tables,
                 jnp.where(valid, pos, 0),
+                backend=cfg.attention_backend,
             ).reshape(B, S, D)
         x = _attn_residual(x, attn, bp, cfg)
         x = _mlp_residual(x, bp, cfg)
@@ -482,7 +486,8 @@ def gpt_verify_step(
     RoPE, and the tied-embedding logits head over ALL window positions
     feeding the ``verify_tokens`` epilogue.
     """
-    from ray_tpu.ops.kv_cache import paged_prefill_attention, write_kv
+    from ray_tpu.ops.kv_cache import write_kv
+    from ray_tpu.ops.paged_attention import prefill_attention
 
     B, W = tokens.shape
     D = cfg.d_model
@@ -502,8 +507,9 @@ def gpt_verify_step(
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
         )
-        attn = paged_prefill_attention(
-            q, k_layer, v_layer, block_tables, jnp.where(valid, pos, 0)
+        attn = prefill_attention(
+            q, k_layer, v_layer, block_tables, jnp.where(valid, pos, 0),
+            backend=cfg.attention_backend,
         ).reshape(B, W, D)
         x = _attn_residual(x, attn, bp, cfg)
         x = _mlp_residual(x, bp, cfg)

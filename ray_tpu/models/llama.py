@@ -375,17 +375,21 @@ def llama_prefill(
     cache_k', cache_v'): sampling fuses into the jitted program and only
     token ids ever cross to host.
 
-    ``start=None`` (the whole-prompt path): RoPE runs at positions 0..S-1
-    and attention is the causal reference kernel over the chunk alone.
+    ``start=None`` (the whole-prompt path): RoPE runs at positions 0..S-1;
+    under the XLA backend attention is the causal reference kernel over
+    the chunk alone, under pallas it is the fused paged-prefill kernel off
+    the just-written cache.
 
     ``start`` [B] int32 (the chunked-prefill / prefix-cache path): row b's
     tokens sit at TRUE positions start[b]..start[b]+lengths[b]-1; earlier
     positions are already resident in the paged cache (a previous chunk,
-    or blocks mapped from the prefix cache), so attention gathers the full
-    paged context (``paged_prefill_attention``) instead of looking only at
-    the chunk. RoPE indexes the true positions, exactly like decode.
+    or blocks mapped from the prefix cache), so attention covers the full
+    paged context via the ``prefill_attention`` backend dispatcher instead
+    of looking only at the chunk. RoPE indexes the true positions, exactly
+    like decode.
     """
-    from ray_tpu.ops.kv_cache import paged_prefill_attention, write_kv
+    from ray_tpu.ops.kv_cache import write_kv
+    from ray_tpu.ops.paged_attention import prefill_attention, resolve_backend
 
     B, S = tokens.shape
     D = cfg.d_model
@@ -409,7 +413,7 @@ def llama_prefill(
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
         )
-        if start is None:
+        if start is None and resolve_backend(cfg.attention_backend) != "pallas":
             # mha_reference repeats GQA kv heads internally
             attn = mha_reference(
                 q.transpose(0, 2, 1, 3),
@@ -419,9 +423,10 @@ def llama_prefill(
             )
             attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
         else:
-            attn = paged_prefill_attention(
+            attn = prefill_attention(
                 q, k_layer, v_layer, block_tables,
                 jnp.where(valid, pos, 0),
+                backend=cfg.attention_backend,
             ).reshape(B, S, D)
         x = x + attn @ bp["wo"].astype(cfg.dtype)
         x, _ = _ffn_residual(x, bp, cfg)
@@ -519,7 +524,7 @@ def llama_verify_step(
     a decode step), columns 1..W-1 are drafted candidates; columns past
     ``draft_len`` [B] are padding. The body is the chunked-prefill
     formulation at true positions (RoPE indexed per position, K/V written
-    for the valid window, ``paged_prefill_attention`` over the full paged
+    for the valid window, ``prefill_attention`` over the full paged
     context) but keeps logits at ALL window positions instead of the last
     valid one, feeding the ``verify_tokens`` epilogue (ops/sampling.py).
 
@@ -536,7 +541,8 @@ def llama_verify_step(
     cache_k', cache_v'); with ``sample=None`` returns the raw window
     logits [B, W, V] f32 instead of verdicts (debug path).
     """
-    from ray_tpu.ops.kv_cache import paged_prefill_attention, write_kv
+    from ray_tpu.ops.kv_cache import write_kv
+    from ray_tpu.ops.paged_attention import prefill_attention
 
     B, W = tokens.shape
     D = cfg.d_model
@@ -555,8 +561,9 @@ def llama_verify_step(
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
         )
-        attn = paged_prefill_attention(
-            q, k_layer, v_layer, block_tables, jnp.where(valid, pos, 0)
+        attn = prefill_attention(
+            q, k_layer, v_layer, block_tables, jnp.where(valid, pos, 0),
+            backend=cfg.attention_backend,
         ).reshape(B, W, D)
         x = x + attn @ bp["wo"].astype(cfg.dtype)
         x, _ = _ffn_residual(x, bp, cfg)

@@ -8,7 +8,12 @@ from ray_tpu.ops.kv_cache import (
     write_kv,
 )
 from ray_tpu.ops.layers import gelu, layer_norm, rms_norm, rope, rope_cache
-from ray_tpu.ops.paged_attention import decode_attention, paged_attention_pallas
+from ray_tpu.ops.paged_attention import (
+    decode_attention,
+    paged_attention_pallas,
+    paged_prefill_attention_pallas,
+    prefill_attention,
+)
 
 __all__ = [
     "flash_attention",
@@ -25,7 +30,9 @@ __all__ = [
     "physical_slots",
     "paged_attention",
     "paged_prefill_attention",
-    # fused decode kernel + backend dispatcher (paged_attention.py)
+    # fused decode/prefill kernels + backend dispatchers (paged_attention.py)
     "paged_attention_pallas",
     "decode_attention",
+    "paged_prefill_attention_pallas",
+    "prefill_attention",
 ]

@@ -16,13 +16,17 @@ allocator, free lists, reuse) lives in serve/llm/kv_cache.py; these
 functions are pure array ops so the model decode paths (models/gpt.py,
 models/llama.py) can use them without depending on the serve layer.
 
-Attention here is the XLA formulation (gather blocks, mask, softmax), the
-CPU default and reference semantics. The block-parallel Pallas decode
-kernel with the same call signature lives in ops/paged_attention.py; model
-decode steps pick between them via ``decode_attention``'s ``backend`` knob
-(threaded from EngineConfig.attention_backend). GQA never materializes
-repeated KV heads in either path: here the queries regroup onto their
-shared KV head and the einsums carry the group as a free axis.
+Attention here is the XLA formulation, the CPU default and reference
+semantics: decode gathers blocks, masks and softmaxes; prefill does the
+same below ``PREFILL_STREAM_MIN_T`` and switches to an online-softmax
+scan over block slabs above it (the padded context never materializes at
+long T). The block-parallel Pallas decode AND prefill kernels with the
+same call signatures live in ops/paged_attention.py; model steps pick
+between backends via the ``decode_attention`` / ``prefill_attention``
+dispatchers' ``backend`` knob (threaded from
+EngineConfig.attention_backend). GQA never materializes repeated KV heads
+in any path: the queries regroup onto their shared KV head and the
+einsums carry the group as a free axis.
 """
 from __future__ import annotations
 
@@ -93,6 +97,76 @@ def gather_kv(
     return keys, values
 
 
+# Context length (NB * block_size) at and above which
+# ``paged_prefill_attention`` switches from the dense one-shot formulation
+# to the streaming (block-slab scan) one. The dense path keeps the full
+# [B, S, Hkv, G, T] f32 score tensor live through softmax, an O(S*T) HBM
+# spike that at the long contexts ROADMAP item 1 targets dwarfs the output;
+# the streaming path peaks at one [B, S, Hkv, G, block_size] slab instead.
+# Numerics differ at the last ulp (online vs one-shot softmax), so short
+# contexts — everything the byte-identity tier-1 suite pins — keep the
+# dense path bit-for-bit; tests monkeypatch this down to cover streaming.
+PREFILL_STREAM_MIN_T = 2048
+
+
+def _paged_prefill_streaming(
+    qg: jax.Array,          # [B, S, Hkv, G, hd] regrouped queries
+    k_layer: jax.Array,
+    v_layer: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: float,
+    window: int | None,
+) -> jax.Array:
+    """Online-softmax scan over physical block slabs: gathers ONE
+    [B, block_size, Hkv, hd] slab per step instead of the whole padded
+    context, carrying flash-style running (max, sum, acc). The padded
+    [B, T] context and the [.., T] score tensor never exist in HBM."""
+    B, S, Hkv, G, hd = qg.shape
+    bs = k_layer.shape[1]
+    NB = block_tables.shape[1]
+
+    def _slab(carry, xs):
+        m, l, acc = carry
+        i, blk = xs
+        keys = k_layer[blk]      # [B, bs, Hkv, hd]
+        values = v_layer[blk]
+        s = jnp.einsum(
+            "bshgd,bthd->bshgt", qg, keys,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        t = i * bs + jnp.arange(bs, dtype=positions.dtype)
+        mask = t[None, None, :] <= positions[:, :, None]   # [B, S, bs]
+        if window is not None:
+            mask = jnp.logical_and(
+                mask, t[None, None, :] > positions[:, :, None] - window
+            )
+        mask = mask[:, :, None, None, :]
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # p is explicitly zeroed where masked: for a fully-masked slab
+        # m_new stays NEG_INF and exp(NEG_INF - NEG_INF) would be 1.
+        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bshgt,bthd->bshgd", p.astype(values.dtype), values,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc_new), None
+
+    init = (
+        jnp.full((B, S, Hkv, G), NEG_INF, jnp.float32),
+        jnp.zeros((B, S, Hkv, G), jnp.float32),
+        jnp.zeros((B, S, Hkv, G, hd), jnp.float32),
+    )
+    xs = (jnp.arange(NB, dtype=positions.dtype), block_tables.T)
+    (_, l, acc), _ = jax.lax.scan(_slab, init, xs)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return acc / l_safe[..., None]
+
+
 def paged_prefill_attention(
     q: jax.Array,
     k_layer: jax.Array,
@@ -101,6 +175,7 @@ def paged_prefill_attention(
     positions: jax.Array,
     *,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Multi-token (chunked-prefill) attention over a paged cache.
 
@@ -110,27 +185,45 @@ def paged_prefill_attention(
     query attends over the sequence's full gathered context with the mask
     ``t <= position`` — i.e. all previously-cached tokens (an earlier
     chunk, or blocks mapped from a prefix cache) plus the causal part of
-    its own chunk. Padding queries attend at whatever clamped position the
-    caller gave them; their outputs are garbage the caller discards.
-    Returns [B, S, H_q, hd] in q.dtype; GQA as in ``paged_attention``.
+    its own chunk. ``window=W`` additionally masks ``t <= position - W``
+    (sliding-window attention). Padding queries attend at whatever clamped
+    position the caller gave them; their outputs are garbage the caller
+    discards. Returns [B, S, H_q, hd] in q.dtype; GQA as in
+    ``paged_attention``.
+
+    Contexts at/above ``PREFILL_STREAM_MIN_T`` take the streaming path
+    (``_paged_prefill_streaming``): the padded [B, T] gather and the full
+    score tensor are never materialized — memory peaks at one block slab.
     """
     B, S, Hq, hd = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(hd)
-    keys, values = gather_kv(k_layer, v_layer, block_tables)  # [B,T,Hkv,hd]
-    Hkv = keys.shape[2]
+    Hkv = k_layer.shape[2]
     # GQA without materializing rep x copies of K/V: queries regroup onto
     # their shared KV head ([B,S,Hq,hd] -> [B,S,Hkv,G,hd] — query head h
     # serves kv head h // G) and the einsums contract against the COMPACT
     # keys/values, carrying the group as a free axis.
-    q = q.reshape(B, S, Hkv, Hq // Hkv, hd)
+    qg = q.reshape(B, S, Hkv, Hq // Hkv, hd)
+    T = block_tables.shape[1] * k_layer.shape[1]
+    if T >= PREFILL_STREAM_MIN_T:
+        out = _paged_prefill_streaming(
+            qg, k_layer, v_layer, block_tables, positions,
+            scale=scale, window=window,
+        )
+        return out.reshape(B, S, Hq, hd).astype(q.dtype)
+    keys, values = gather_kv(k_layer, v_layer, block_tables)  # [B,T,Hkv,hd]
     logits = jnp.einsum(
-        "bshgd,bthd->bshgt", q, keys, preferred_element_type=jnp.float32
+        "bshgd,bthd->bshgt", qg, keys, preferred_element_type=jnp.float32
     ) * scale
-    T = keys.shape[1]
     mask = (
         jnp.arange(T, dtype=positions.dtype)[None, None, :]
         <= positions[:, :, None]
     )  # [B, S, T]
+    if window is not None:
+        mask = jnp.logical_and(
+            mask,
+            jnp.arange(T, dtype=positions.dtype)[None, None, :]
+            > positions[:, :, None] - window,
+        )
     logits = jnp.where(mask[:, :, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(values.dtype)
     out = jnp.einsum("bshgt,bthd->bshgd", probs, values)

@@ -44,6 +44,22 @@ other HLO.
 resolving to the Pallas kernel on TPU and the XLA formulation elsewhere
 (CPU interpret-mode grids are trace-time-unrolled — correct, but not a
 default worth paying for).
+
+PREFILL (ISSUE 18): ``paged_prefill_attention_pallas`` is the multi-token
+sibling — the same block-table walk extended with a query-block axis, so
+fresh prefill, chunked prefill at true-position offsets, and the PR-8
+verify windows all run off the paged pool without ever materializing the
+padded ``[B, T]`` context. Grid ``(B, Hkv, q_blocks, kv_blocks)``; the
+causal frontier per (b, q-block) rides in as scalar-prefetch operands
+(``qmax``/``qmin``, reduced from the per-row positions), so kv-blocks
+wholly past the frontier are skipped — compute AND (via index-map
+dedupe) DMA — which is where the asymptotic win over the dense XLA path
+comes from on long contexts: a chunk of C queries against a T-token
+context costs O(C·T_attended) tiles instead of O(C·T_padded) HBM gather
+traffic. A static ``window=`` arg adds the sliding-window variant that
+also skips kv-blocks below the window floor. ``prefill_attention`` is
+the dispatcher the model prefill/verify paths call, behind the same
+``attention_backend`` knob as decode.
 """
 from __future__ import annotations
 
@@ -256,4 +272,263 @@ def decode_attention(
 
     return _xla_paged_attention(
         q, k_layer, v_layer, block_tables, positions, scale=scale
+    )
+
+
+def _paged_prefill_kernel(
+    tables_ref,   # scalar prefetch: [B, NB] int32 block tables
+    qmax_ref,     # scalar prefetch: [B, nqb] int32 frontier per q-block
+    qmin_ref,     # scalar prefetch: [B, nqb] int32 floor per q-block
+    q_ref,        # [1, 1, qb*G, hd] — this (b, kv-head, q-block)'s rows,
+                  # pre-scaled, row r = query (r // G) of the block, group
+                  # member (r % G)
+    pos_ref,      # [1, qb] int32 — true positions of this q-block's rows
+    k_ref,        # [1, bs, 1, hd] — one physical KV block, one kv head
+    v_ref,        # [1, bs, 1, hd]
+    o_ref,        # [1, 1, qb*G, hd]
+    m_scr,        # VMEM [qb*G, 128] f32 running max (lane-broadcast)
+    l_scr,        # VMEM [qb*G, 128] f32 running sum (lane-broadcast)
+    acc_scr,      # VMEM [qb*G, hd] f32 output accumulator
+    *,
+    block_size: int,
+    gqa: int,
+    window: int | None,
+):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    i = pl.program_id(3)
+    n_kv = pl.num_programs(3)
+    qmax = qmax_ref[b, j]
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # kv-blocks entirely past this q-block's causal frontier contribute
+    # nothing — their (deduped) fetch is skipped and so is their compute.
+    # With a sliding window, blocks entirely below the window floor of the
+    # EARLIEST query in the block are skipped the same way.
+    needed = i * block_size <= qmax
+    if window is not None:
+        needed = jnp.logical_and(
+            needed, (i + 1) * block_size > qmin_ref[b, j] - (window - 1)
+        )
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]        # [qb*G, hd], pre-scaled by scale * log2(e)
+        k = k_ref[0, :, 0, :]  # [bs, hd]
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                      # [qb*G, bs]
+        # per-ROW causal mask: row r carries query (r // G)'s true
+        # position; expand the [qb] position tile across the G group
+        # members (broadcast + reshape — never a head repeat in HBM)
+        qb = pos_ref.shape[1]
+        pos_rows = jnp.broadcast_to(
+            pos_ref[0][:, None], (qb, gqa)
+        ).reshape(qb * gqa, 1)
+        t = i * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = t <= pos_rows
+        if window is not None:
+            mask = jnp.logical_and(mask, t > pos_rows - window)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                       # [qb*G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # bf16 inputs: exp2 at half precision (2x VPU lanes), matching the
+        # flash forward; f32 inputs keep a fully-f32 softmax
+        if q.dtype == jnp.bfloat16:
+            p = jnp.exp2((s - m_new).astype(jnp.bfloat16))
+        else:
+            p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(
+            p, axis=1, keepdims=True, dtype=jnp.float32
+        )
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(i == n_kv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_prefill_attention_pallas(
+    q: jax.Array,
+    k_layer: jax.Array,
+    v_layer: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: float | None = None,
+    window: int | None = None,
+    q_block: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Multi-token (prefill / chunked-prefill / verify-window) attention
+    straight off the paged KV pool.
+
+    Same contract as ``ops/kv_cache.paged_prefill_attention``: q
+    ``[B, S, H_q, hd]`` is a CHUNK of queries whose own K/V were already
+    written via ``write_kv``, ``positions`` ``[B, S]`` int32 gives every
+    query's TRUE logical position (callers zero padding columns — their
+    outputs are garbage the caller discards), pool layers
+    ``[num_blocks, block_size, H_kv, hd]``, ``block_tables`` ``[B, NB]``
+    int32 padded with the garbage block 0. Returns ``[B, S, H_q, hd]``
+    in q.dtype.
+
+    The grid is ``(B, H_kv, q_blocks, kv_blocks)`` with the kv axis
+    innermost: per (b, kv-head, q-block) the flash running softmax walks
+    the sequence's block table, DMAing one physical ``[block_size, hd]``
+    tile per step. The per-(b, q-block) causal frontier (``max`` of the
+    block's positions) and floor (``min``) ride in as scalar-prefetch
+    operands next to the block table: the index map re-issues block 0's
+    index for kv-blocks the q-block cannot attend (Pallas dedupes the
+    DMA) and ``@pl.when`` skips their compute. ``window=W`` (static)
+    additionally masks ``t <= pos - W`` and skips kv-blocks wholly below
+    the window floor — sliding-window attention at O(S·W) cost.
+
+    ``q_block`` tiles the chunk axis (default: whole chunk up to 128
+    rows; S is padded up to a multiple with position-0 rows and the pad
+    is sliced off). ``interpret`` defaults to True off-TPU so tier-1
+    executes the kernel on CPU.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    B, S, Hq, hd = q.shape
+    _, bs, Hkv, _ = k_layer.shape
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of KV heads ({Hkv})"
+        )
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    G = Hq // Hkv
+    NB = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    qb = q_block if q_block is not None else min(S, 128)
+    nqb = -(-S // qb)
+    Sp = nqb * qb
+    pos = positions.astype(jnp.int32)
+    if Sp != S:
+        q = jnp.pad(q, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+        pos = jnp.pad(pos, ((0, 0), (0, Sp - S)))
+    # fold softmax scale AND log2(e) into q once — base-2 softmax
+    # in-kernel. [B, S, Hq, hd] -> [B, Hkv, S*G, hd]: query head h serves
+    # kv head h // G (the jnp.repeat head mapping, compacted), and the
+    # (query, group) rows flatten s-major so a q tile is G-contiguous.
+    qf = (q * jnp.asarray(scale * LOG2E, q.dtype)).reshape(
+        B, Sp, Hkv, G, hd
+    ).transpose(0, 2, 1, 3, 4).reshape(B, Hkv, Sp * G, hd)
+    tables = block_tables.astype(jnp.int32)
+    posb = pos.reshape(B, nqb, qb)
+    # causal frontier / window floor per (b, q-block) — the scalars the
+    # index map and @pl.when guards read. Padding rows sit at position 0,
+    # so they never extend the frontier (and only make the floor
+    # conservative, never wrong).
+    qmax = jnp.max(posb, axis=2).astype(jnp.int32)
+    qmin = jnp.min(posb, axis=2).astype(jnp.int32)
+
+    def q_map(b, h, j, i, tables_ref, qmax_ref, qmin_ref):
+        return (b, h, j, 0)
+
+    def pos_map(b, h, j, i, tables_ref, qmax_ref, qmin_ref):
+        return (b, j)
+
+    def kv_map(b, h, j, i, tables_ref, qmax_ref, qmin_ref):
+        # Walk the sequence's block table. kv-blocks the q-block cannot
+        # attend (wholly past its frontier, or — windowed — wholly below
+        # its floor) re-issue entry 0's index: consecutive identical
+        # block tuples make Pallas skip the DMA, so skipped blocks cost
+        # no bandwidth (their compute is skipped by the same test).
+        needed = i * bs <= qmax_ref[b, j]
+        if window is not None:
+            needed = jnp.logical_and(
+                needed, (i + 1) * bs > qmin_ref[b, j] - (window - 1)
+            )
+        entry = jnp.where(needed, tables_ref[b, i], tables_ref[b, 0])
+        return (entry, 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, Hkv, nqb, NB),
+        in_specs=[
+            pl.BlockSpec((1, 1, qb * G, hd), q_map),
+            pl.BlockSpec((1, qb), pos_map),
+            pl.BlockSpec((1, bs, 1, hd), kv_map),
+            pl.BlockSpec((1, bs, 1, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, qb * G, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((qb * G, 128), jnp.float32),
+            pltpu.VMEM((qb * G, 128), jnp.float32),
+            pltpu.VMEM((qb * G, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_prefill_kernel, block_size=bs, gqa=G, window=window
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, Sp * G, hd), q.dtype),
+        compiler_params=_tpu_compiler_params(
+            vmem_limit_bytes=100 * 1024 * 1024,
+            dimension_semantics=(
+                "parallel", "parallel", "parallel", "arbitrary"
+            ),
+        ),
+        interpret=interpret,
+    )(tables, qmax, qmin, qf, pos, k_layer, v_layer)
+    out = out.reshape(B, Hkv, Sp, G, hd).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, Sp, Hq, hd)[:, :S]
+
+
+def prefill_attention(
+    q: jax.Array,
+    k_layer: jax.Array,
+    v_layer: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    *,
+    scale: float | None = None,
+    backend: str = "auto",
+    window: int | None = None,
+) -> jax.Array:
+    """Backend dispatcher for multi-token paged attention — the one entry
+    point the model prefill, chunked-prefill, and verify paths call.
+    ``backend`` is the same ``attention_backend`` knob as
+    ``decode_attention`` (static in the traced step, part of the engine's
+    jit-cache key, zero new compile kinds); both backends share the exact
+    call signature and numerics contract, so token streams are
+    byte-identical across them (tests/test_paged_attention.py).
+    ``window`` selects sliding-window attention (see
+    ``paged_prefill_attention_pallas``)."""
+    if resolve_backend(backend) == "pallas":
+        return paged_prefill_attention_pallas(
+            q, k_layer, v_layer, block_tables, positions,
+            scale=scale, window=window,
+        )
+    from ray_tpu.ops.kv_cache import (
+        paged_prefill_attention as _xla_paged_prefill,
+    )
+
+    return _xla_paged_prefill(
+        q, k_layer, v_layer, block_tables, positions,
+        scale=scale, window=window,
     )

@@ -96,3 +96,20 @@ def test_paged_attn_shape_env_override(monkeypatch):
     monkeypatch.setenv("RAY_TPU_PAGED_ATTN_SHAPE", "4,8")
     with pytest.raises(ValueError):
         llm_serving._paged_attn_env_shape()
+
+
+def test_paged_prefill_shape_env_override(monkeypatch):
+    """The prefill microbench's shape override is the decode one's
+    5-int twin: RAY_TPU_PAGED_PREFILL_SHAPE="B,S,Hq,Hkv,hd" (',' or 'x'
+    separated), unset means None, malformed fails loudly."""
+    from ray_tpu.benchmarks import llm_serving
+
+    monkeypatch.delenv("RAY_TPU_PAGED_PREFILL_SHAPE", raising=False)
+    assert llm_serving._paged_prefill_env_shape() is None
+    monkeypatch.setenv("RAY_TPU_PAGED_PREFILL_SHAPE", "2,32,4,2,32")
+    assert llm_serving._paged_prefill_env_shape() == (2, 32, 4, 2, 32)
+    monkeypatch.setenv("RAY_TPU_PAGED_PREFILL_SHAPE", "2x32x4x2x32")
+    assert llm_serving._paged_prefill_env_shape() == (2, 32, 4, 2, 32)
+    monkeypatch.setenv("RAY_TPU_PAGED_PREFILL_SHAPE", "2,32,4")
+    with pytest.raises(ValueError):
+        llm_serving._paged_prefill_env_shape()

@@ -1,14 +1,20 @@
-"""Fused Pallas paged-attention decode kernel (ISSUE 8).
+"""Fused Pallas paged-attention kernels (ISSUE 8 decode, ISSUE 18 prefill).
 
-Two layers of pinning on CPU (the kernel runs in Pallas interpret mode —
+Two layers of pinning on CPU (the kernels run in Pallas interpret mode —
 real kernel code, HLO-interpreted):
 
 - KERNEL: ``paged_attention_pallas`` vs the XLA ``paged_attention``
   formulation on one shared paged pool — contiguous and shuffled block
   tables, GQA ratios 1/2/4, ragged positions with block-0-padded
-  tables, eager and jitted.
+  tables, eager and jitted. ``paged_prefill_attention_pallas`` the same
+  way against ``mha_reference`` (fresh prompts) and the XLA
+  ``paged_prefill_attention`` (ragged chunk starts at true positions,
+  verify-window per-column positions, q-block padding), plus
+  sliding-window equivalence to a masked dense reference on all three
+  implementations.
 - ENGINE: ``attention_backend="pallas"`` produces byte-identical token
   streams to ``"xla"`` — greedy and temperature/top-p, gpt and llama,
+  fresh prefill, chunked prefill and speculative verify,
   SingleDeviceExecutor and tp/fsdp ShardedExecutor — and the
   compile-kind contract is frozen across backends (same signature set,
   no new kinds).
@@ -157,6 +163,212 @@ def test_backend_resolution_and_validation(jax_cpu):
         )
 
 
+# ------------------------------------------- prefill kernel vs references
+
+
+def _prefill_pool(key, lengths, Hkv, hd, bs, NB, shuffle):
+    """Like ``_pool`` but also returns the dense per-row contexts (kc, vc)
+    written into the paged layers, so tests can build dense references
+    without re-gathering."""
+    import random as _random
+
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.kv_cache import write_kv
+
+    B = len(lengths)
+    num_blocks = 1 + B * NB
+    ids = list(range(1, num_blocks))
+    if shuffle:
+        _random.Random(7).shuffle(ids)
+    rows, nxt = [], 0
+    for L in lengths:
+        need = -(-L // bs)
+        rows.append(ids[nxt:nxt + need] + [0] * (NB - need))
+        nxt += need
+    tables = jnp.asarray(rows, jnp.int32)
+    T = NB * bs
+    kc = jax.random.normal(jax.random.fold_in(key, 1), (B, T, Hkv, hd))
+    vc = jax.random.normal(jax.random.fold_in(key, 2), (B, T, Hkv, hd))
+    shape = (num_blocks, bs, Hkv, hd)
+    k_layer = jax.random.normal(jax.random.fold_in(key, 3), shape)
+    v_layer = jax.random.normal(jax.random.fold_in(key, 4), shape)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    valid = pos < jnp.asarray(lengths, jnp.int32)[:, None]
+    k_layer, v_layer = write_kv(
+        k_layer, v_layer, kc, vc, pos, tables, valid=valid
+    )
+    return k_layer, v_layer, tables, kc, vc
+
+
+@pytest.mark.parametrize("gqa", [1, 2, 4])
+@pytest.mark.parametrize("shuffle", [False, True])
+def test_prefill_kernel_matches_mha_reference(jax_cpu, gqa, shuffle):
+    """Fresh whole-prompt prefill (positions 0..S-1, everything cached)
+    equals causal dense attention over the chunk."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.attention import mha_reference
+    from ray_tpu.ops.paged_attention import paged_prefill_attention_pallas
+
+    key = jax.random.PRNGKey(200 + gqa)
+    B, S, Hkv, hd, bs, NB = 2, 24, 2, 32, 8, 4
+    k_layer, v_layer, tables, kc, vc = _prefill_pool(
+        key, [S] * B, Hkv, hd, bs, NB, shuffle
+    )
+    q = jax.random.normal(jax.random.fold_in(key, 9), (B, S, Hkv * gqa, hd))
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    out = paged_prefill_attention_pallas(
+        q, k_layer, v_layer, tables, positions
+    )
+    ref = mha_reference(
+        q.transpose(0, 2, 1, 3),
+        kc[:, :S].transpose(0, 2, 1, 3),
+        vc[:, :S].transpose(0, 2, 1, 3),
+        causal=True,
+    ).transpose(0, 2, 1, 3)
+    assert out.shape == ref.shape
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5, (gqa, shuffle)
+
+
+@pytest.mark.parametrize("gqa", [1, 2, 4])
+@pytest.mark.parametrize("shuffle", [False, True])
+def test_prefill_kernel_ragged_starts_match_xla(jax_cpu, gqa, shuffle):
+    """Chunked prefill: each row's chunk sits at a different TRUE start
+    over a different amount of resident context."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.kv_cache import paged_prefill_attention
+    from ray_tpu.ops.paged_attention import paged_prefill_attention_pallas
+
+    key = jax.random.PRNGKey(300 + gqa)
+    lengths = [6, 17, 29]
+    S, Hkv, hd, bs, NB = 6, 2, 16, 8, 4
+    k_layer, v_layer, tables, _, _ = _prefill_pool(
+        key, lengths, Hkv, hd, bs, NB, shuffle
+    )
+    # the chunk is the LAST S cached positions of each row
+    starts = jnp.asarray([L - S for L in lengths], jnp.int32)
+    positions = starts[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    q = jax.random.normal(
+        jax.random.fold_in(key, 9), (len(lengths), S, Hkv * gqa, hd)
+    )
+    ref = paged_prefill_attention(q, k_layer, v_layer, tables, positions)
+    out = paged_prefill_attention_pallas(
+        q, k_layer, v_layer, tables, positions
+    )
+    assert out.shape == ref.shape and out.dtype == ref.dtype
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5, (gqa, shuffle)
+
+
+@pytest.mark.parametrize("window", [1, 4, 16])
+def test_prefill_window_matches_masked_dense(jax_cpu, window, monkeypatch):
+    """Sliding-window attention: all three implementations — the pallas
+    kernel (skips kv-blocks below the window floor), the dense XLA path,
+    and the streaming XLA path — equal a dense reference with the mask
+    ``pos - window < t <= pos`` applied explicitly."""
+    import jax
+    import jax.numpy as jnp
+    import ray_tpu.ops.kv_cache as kvc
+    from ray_tpu.ops.paged_attention import paged_prefill_attention_pallas
+
+    key = jax.random.PRNGKey(400 + window)
+    lengths = [11, 30]
+    S, Hkv, hd, bs, NB = 8, 2, 16, 8, 4
+    k_layer, v_layer, tables, kc, vc = _prefill_pool(
+        key, lengths, Hkv, hd, bs, NB, shuffle=True
+    )
+    starts = jnp.asarray([L - S for L in lengths], jnp.int32)
+    positions = starts[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    B = len(lengths)
+    q = jax.random.normal(jax.random.fold_in(key, 9), (B, S, Hkv * 2, hd))
+
+    # dense reference over the raw contexts with the window mask explicit
+    T = NB * bs
+    scale = 1.0 / (hd ** 0.5)
+    qg = q.reshape(B, S, Hkv, 2, hd)
+    logits = jnp.einsum("bshgd,bthd->bshgt", qg, kc) * scale
+    t = jnp.arange(T, dtype=jnp.int32)[None, None, :]
+    mask = (t <= positions[:, :, None]) & (
+        t > positions[:, :, None] - window
+    )
+    logits = jnp.where(mask[:, :, None, None, :], logits, -1e30)
+    ref = jnp.einsum(
+        "bshgt,bthd->bshgd", jax.nn.softmax(logits, axis=-1), vc
+    ).reshape(B, S, Hkv * 2, hd)
+
+    out_k = paged_prefill_attention_pallas(
+        q, k_layer, v_layer, tables, positions, window=window
+    )
+    out_d = kvc.paged_prefill_attention(
+        q, k_layer, v_layer, tables, positions, window=window
+    )
+    monkeypatch.setattr(kvc, "PREFILL_STREAM_MIN_T", 1)
+    out_s = kvc.paged_prefill_attention(
+        q, k_layer, v_layer, tables, positions, window=window
+    )
+    for name, out in (("pallas", out_k), ("dense", out_d), ("stream", out_s)):
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5, (name, window)
+
+
+def test_prefill_verify_window_per_column_positions(jax_cpu):
+    """Speculative verify windows: per-row starts AND per-column true
+    positions, padding columns clamped to position 0 exactly as the
+    models pass them."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.kv_cache import paged_prefill_attention
+    from ray_tpu.ops.paged_attention import paged_prefill_attention_pallas
+
+    key = jax.random.PRNGKey(500)
+    W, Hkv, hd, bs, NB = 4, 2, 16, 8, 4
+    starts = jnp.asarray([3, 11], jnp.int32)
+    draft_len = jnp.asarray([1, 3], jnp.int32)
+    lengths = [int(s) + int(d) + 1 for s, d in zip(starts, draft_len)]
+    k_layer, v_layer, tables, _, _ = _prefill_pool(
+        key, lengths, Hkv, hd, bs, NB, shuffle=True
+    )
+    pos = starts[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(W, dtype=jnp.int32)[None, :] <= draft_len[:, None]
+    positions = jnp.where(valid, pos, 0)
+    q = jax.random.normal(jax.random.fold_in(key, 9), (2, W, Hkv * 2, hd))
+    ref = paged_prefill_attention(q, k_layer, v_layer, tables, positions)
+    out = paged_prefill_attention_pallas(
+        q, k_layer, v_layer, tables, positions
+    )
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_prefill_kernel_qblock_padding_and_jit(jax_cpu):
+    """A q_block that does not divide S exercises the pad-and-slice path
+    (multiple q-blocks, per-block frontiers), and the dispatcher stays
+    jittable with the backend static."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops.kv_cache import paged_prefill_attention
+    from ray_tpu.ops.paged_attention import (
+        paged_prefill_attention_pallas, prefill_attention,
+    )
+
+    key = jax.random.PRNGKey(600)
+    lengths = [12, 27]
+    S, Hkv, hd, bs, NB = 12, 2, 16, 8, 4
+    k_layer, v_layer, tables, _, _ = _prefill_pool(
+        key, lengths, Hkv, hd, bs, NB, shuffle=True
+    )
+    starts = jnp.asarray([L - S for L in lengths], jnp.int32)
+    positions = starts[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    q = jax.random.normal(jax.random.fold_in(key, 9), (2, S, Hkv * 2, hd))
+    ref = paged_prefill_attention(q, k_layer, v_layer, tables, positions)
+    out = paged_prefill_attention_pallas(
+        q, k_layer, v_layer, tables, positions, q_block=5
+    )
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+    jitted = jax.jit(lambda *a: prefill_attention(*a, backend="pallas"))
+    out_j = jitted(q, k_layer, v_layer, tables, positions)
+    assert float(jnp.max(jnp.abs(out_j - ref))) < 2e-5
+
+
 # ------------------------------------------------ engine stream parity
 
 
@@ -204,6 +416,73 @@ def test_sharded_streams_identical_across_backends(jax_cpu, family):
     assert outs["pallas"] == outs["xla"], family
 
 
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_chunked_prefill_streams_identical_across_backends(jax_cpu, family):
+    """Long prompts through ``prefill_chunk_tokens`` slices: every chunk
+    after the first runs the TRUE-position paged path, so this pins the
+    kernel's ragged-start masking end to end."""
+    prompt = [3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47,
+              53, 59, 61, 67, 71, 73]
+    outs = {}
+    for backend in ("xla", "pallas"):
+        eng = _engine(family, _model_config(family),
+                      attention_backend=backend, prefill_chunk_tokens=8)
+        outs[backend] = [
+            eng.generate(prompt, max_new_tokens=10),
+            eng.generate(prompt, max_new_tokens=8,
+                         temperature=0.8, top_p=0.9, seed=17),
+        ]
+        assert any(
+            s[0] == "prefill_chunk" for s in eng.fns.signatures
+        ), backend
+        eng.shutdown()
+    assert outs["pallas"] == outs["xla"], family
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_spec_verify_streams_identical_across_backends(jax_cpu, family):
+    """Speculative decoding's verify windows run the prefill kernel at
+    per-column positions with padding columns clamped to 0 — the stream
+    (committed tokens only) must still be byte-identical across
+    backends, greedy and sampled."""
+    motif = [435, 326, 262, 138, 158, 21, 39, 9]
+    outs = {}
+    for backend in ("xla", "pallas"):
+        eng = _engine(family, _model_config(family),
+                      attention_backend=backend, speculative_k=2)
+        outs[backend] = [
+            eng.generate(motif * 3, max_new_tokens=12),
+            eng.generate(motif * 3, max_new_tokens=10,
+                         temperature=0.9, top_p=0.8, seed=5),
+        ]
+        assert any(s[0] == "verify" for s in eng.fns.signatures), backend
+        eng.shutdown()
+    assert outs["pallas"] == outs["xla"], family
+
+
+def test_sharded_chunked_and_verify_streams_identical(jax_cpu):
+    """tp=2/fsdp=2: chunked prefill and speculative verify per shard over
+    the head-sharded pool — the prefill kernel is head-count-agnostic, so
+    streams match XLA under GSPMD unchanged."""
+    motif = [435, 326, 262, 138, 158, 21, 39, 9]
+    long_prompt = list(range(3, 43, 2))
+    outs = {}
+    for backend in ("xla", "pallas"):
+        eng = _engine("llama", _model_config(),
+                      attention_backend=backend, tp=2, fsdp=2,
+                      prefill_chunk_tokens=8, speculative_k=2)
+        assert eng.executor.kind == "sharded"
+        outs[backend] = [
+            eng.generate(long_prompt, max_new_tokens=8),
+            eng.generate(motif * 3, max_new_tokens=10,
+                         temperature=0.9, top_p=0.8, seed=5),
+        ]
+        kinds = {s[0] for s in eng.fns.signatures}
+        assert {"prefill_chunk", "verify"} <= kinds, (backend, kinds)
+        eng.shutdown()
+    assert outs["pallas"] == outs["xla"]
+
+
 def test_backend_via_model_parallel_config(jax_cpu):
     """The mesh-object spelling threads too, and engine-level
     attention_backend wins over the mesh's."""
@@ -232,19 +511,26 @@ def test_compile_contract_frozen_across_backends(jax_cpu):
     signature SET as an identically-driven xla engine, and further
     sampled traffic on the pallas engine compiles nothing new."""
 
+    motif = [435, 326, 262, 138, 158, 21, 39, 9]
+
     def drive(eng):
         for kw in (dict(),
                    dict(temperature=0.7, top_p=0.9, seed=2)):
             eng.generate([3, 5, 7, 11], max_new_tokens=6, **kw)
+        # long prompt -> prefill_chunk signatures; the motif prompt's
+        # spec run -> verify signatures
+        eng.generate(list(range(3, 43, 2)), max_new_tokens=4)
+        eng.generate(motif * 3, max_new_tokens=6)
         return set(eng.fns.signatures)
 
     engs = {
-        b: _engine("llama", _model_config(), attention_backend=b)
+        b: _engine("llama", _model_config(), attention_backend=b,
+                   prefill_chunk_tokens=8, speculative_k=2)
         for b in ("xla", "pallas")
     }
     sigs = {b: drive(e) for b, e in engs.items()}
     assert {s[0] for s in sigs["pallas"]} <= {
-        "prefill", "prefill_chunk", "decode"
+        "prefill", "prefill_chunk", "decode", "verify"
     }
     assert sigs["pallas"] == sigs["xla"]
 
