@@ -723,6 +723,13 @@ class GcsService:
             events = list(self._task_events)
         if p and p.get("job_id"):
             events = [e for e in events if e.get("job_id") == p["job_id"]]
+        if p and p.get("trace_id"):
+            # server-side trace filter: one trace's fetch cost no longer
+            # scales with total task-event volume (tracing.get_trace)
+            events = [e for e in events if e.get("trace_id") == p["trace_id"]]
+        if p and p.get("limit"):
+            # newest-first cap — a post-mortem wants the tail, not the head
+            events = events[-int(p["limit"]):]
         return {"events": events}
 
 

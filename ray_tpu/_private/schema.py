@@ -78,7 +78,7 @@ SCHEMAS: dict[str, dict[str, dict]] = {
         "unsubscribe": _spec("topic"),
         "publish": _spec("topic payload"),
         "add_task_events": _spec("events"),
-        "list_task_events": _spec("job_id"),
+        "list_task_events": _spec("job_id", "trace_id limit"),
     },
     "raylet": {
         "pull_object": _spec("object_id", "length offset"),

@@ -243,6 +243,11 @@ LOAD_PREEMPTION = {           # aggressive thresholds: CPU tiny-model scale
     "kv_pressure": 0.75, "queue_wait_s": 0.08,
     "resume_pressure": 0.5, "aging_s": 8.0,
 }
+# head-sampling rate for the load window: deterministic per request id
+# (trace_store.sample_decision), so the traced subset is stable across
+# runs. The chaos-tagged stream is ALWAYS traced — its failover trace is
+# the bench's end-to-end check of the fleet trace plane.
+LOAD_TRACE_RATE = 0.25
 # fleet prefix bench: a few distinct system prompts with zipf popularity
 # streamed over a live >=2-replica fleet. Prefix length is a multiple of
 # block_size so the whole system prompt registers as full chain-digest
@@ -1241,6 +1246,8 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
 
     import jax.numpy as jnp
 
+    from contextlib import nullcontext
+
     import ray_tpu
     from ray_tpu import serve
     from ray_tpu._private import chaos
@@ -1251,6 +1258,8 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
     from ray_tpu.serve.llm import (
         EngineConfig, LLMEngine, build_llm_app, stream_tokens, structured,
     )
+    from ray_tpu.serve.trace_store import sample_decision
+    from ray_tpu.util import tracing
 
     plan = FaultPlan(seed=LOAD_SEED, faults=(
         Fault(point="llm.token", action="kill",
@@ -1281,34 +1290,48 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
             time.sleep(delay)
         rec = {"i": idx, "payload": payload, "shed": False, "error": None,
                "chunks": [], "arrivals": [],
-               "dispatched": time.perf_counter(), "failovers": 0}
+               "dispatched": time.perf_counter(), "failovers": 0,
+               "trace_id": None}
+        # head sampling, bench-side: the chaos-tagged stream is always
+        # traced (its failover trace is asserted below); the rest trace
+        # at the deterministic per-request-id rate
+        traced = ("chaos_tag" in payload
+                  or sample_decision(payload["request_id"], LOAD_TRACE_RATE))
         while True:
-            gen = stream_tokens(
-                handle, payload, prefill_handle=prefill_handle)
-            try:
-                for chunk in gen:
-                    rec["arrivals"].append(time.perf_counter())
-                    rec["chunks"].append(chunk)
-            except Exception as e:  # noqa: BLE001 — shed vs real error
-                from ray_tpu.exceptions import TaskError
+            root = (tracing.span("bench.request",
+                                 request_id=payload["request_id"])
+                    if traced else nullcontext(None))
+            with root as sctx:
+                if sctx is not None:
+                    rec["trace_id"] = sctx["trace_id"]
+                gen = stream_tokens(
+                    handle, payload, prefill_handle=prefill_handle)
+                try:
+                    for chunk in gen:
+                        rec["arrivals"].append(time.perf_counter())
+                        rec["chunks"].append(chunk)
+                except Exception as e:  # noqa: BLE001 — shed vs real error
+                    from ray_tpu.exceptions import TaskError
 
-                cause = e.cause if isinstance(e, TaskError) and e.cause else e
-                if isinstance(cause, EngineOverloadedError):
-                    # the tagged request anchors the chaos kill: it must
-                    # actually stream, so it rides out shed windows
-                    # (open-loop clients don't retry; this one is the
-                    # fault injector, not a latency sample)
-                    if ("chaos_tag" in payload
-                            and time.perf_counter() - t0 < 90.0):
-                        rec["chunks"].clear()
-                        rec["arrivals"].clear()
-                        time.sleep(0.25)
-                        rec["dispatched"] = time.perf_counter()
-                        continue
-                    rec["shed"] = True  # router shed or admission reject
-                else:
-                    rec["error"] = repr(e)
-            rec["failovers"] = gen.failovers
+                    cause = (e.cause if isinstance(e, TaskError) and e.cause
+                             else e)
+                    if isinstance(cause, EngineOverloadedError):
+                        # the tagged request anchors the chaos kill: it
+                        # must actually stream, so it rides out shed
+                        # windows (open-loop clients don't retry; this
+                        # one is the fault injector, not a latency
+                        # sample)
+                        if ("chaos_tag" in payload
+                                and time.perf_counter() - t0 < 90.0):
+                            rec["chunks"].clear()
+                            rec["arrivals"].clear()
+                            time.sleep(0.25)
+                            rec["dispatched"] = time.perf_counter()
+                            continue
+                        rec["shed"] = True  # router shed / admission reject
+                    else:
+                        rec["error"] = repr(e)
+                rec["failovers"] = gen.failovers
             break
         with results_lock:
             results.append(rec)
@@ -1435,6 +1458,34 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
         try:
             fleet = ray_tpu.get(ctrl.fleet_metrics.remote(), timeout=30)
         except Exception:  # noqa: BLE001 — crosscheck degrades below
+            pass
+        # -- trace plane, before teardown erases the store: push the
+        # driver's span buffer (the bench root spans and the router's
+        # dispatch/resume spans live HERE, and the controller cannot
+        # poll the driver), then confirm the killed stream's trace
+        # assembled at the fleet endpoint — client spans joined with
+        # the survivor replica's polled engine spans under ONE trace id.
+        killed_trace_assembled = False
+        killed_trace_sources = 0
+        killed = next(
+            (r for r in results if "chaos_tag" in r["payload"]), None)
+        try:
+            ray_tpu.get(ctrl.trace_push.remote(
+                tracing.drain_buffered_spans(), "client"), timeout=30)
+            if killed is not None and killed["trace_id"]:
+                deadline = time.perf_counter() + 15.0
+                while time.perf_counter() < deadline:
+                    tree = ray_tpu.get(ctrl.trace_get.remote(
+                        killed["trace_id"]), timeout=10)
+                    if tree is not None:
+                        srcs = [s for s in tree["sources"]
+                                if s.startswith("replica:")]
+                        if srcs and "failover" in tree["status"]:
+                            killed_trace_assembled = True
+                            killed_trace_sources = len(tree["sources"])
+                            break
+                    time.sleep(0.25)
+        except Exception:  # noqa: BLE001 — reported as un-assembled
             pass
     finally:
         serve.shutdown()
@@ -1612,6 +1663,14 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
         "llm_load_json_requests": json_requests,
         "llm_load_json_valid": json_valid,
         "llm_load_failovers": sum(r["failovers"] for r in results),
+        # trace plane: head-sampled fraction of the load window, and the
+        # end-to-end check that the chaos-killed stream's trace came back
+        # assembled (failover-retained, survivor replica spans joined)
+        # from the fleet endpoint before teardown
+        "llm_load_traced_rate": round(
+            sum(1 for r in results if r["trace_id"]) / max(total, 1), 4),
+        "llm_load_killed_trace_assembled": killed_trace_assembled,
+        "llm_load_killed_trace_sources": killed_trace_sources,
         "llm_load_scale_events": scale_events,
         "llm_load_max_replicas": max(
             (s["running_replicas"] for s in status_samples), default=None),

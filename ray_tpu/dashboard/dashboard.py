@@ -168,6 +168,89 @@ class Dashboard:
                 )
             return web.json_response({"series": hist})
 
+        async def traces(request):
+            """Tail-sampled fleet traces held by the controller's
+            TraceStore: ``?app=``, ``?status=`` (a retention flag, or
+            ``slow``/``sampled``), ``?min_duration_s=``, ``?limit=``."""
+            q = request.query
+
+            def _list():
+                ctrl = _controller()
+                return ray_tpu.get(ctrl.trace_list.remote(
+                    app=q.get("app"), status=q.get("status"),
+                    min_duration_s=(float(q["min_duration_s"])
+                                    if "min_duration_s" in q else None),
+                    limit=int(q.get("limit", 100)),
+                ), timeout=30)
+
+            try:
+                out = await offload(_list)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"serve controller unavailable: {e}"},
+                    status=503,
+                )
+            return web.json_response({"traces": out})
+
+        def _trace_call(method, trace_id):
+            ctrl = _controller()
+            return ray_tpu.get(
+                getattr(ctrl, method).remote(trace_id), timeout=30)
+
+        async def trace_get(request):
+            """One assembled trace tree — spans from every process the
+            request touched (proxy, router, prefill, decode), nested."""
+            try:
+                out = await offload(
+                    _trace_call, "trace_get", request.match_info["trace_id"])
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"serve controller unavailable: {e}"},
+                    status=503,
+                )
+            if out is None:
+                return web.json_response(
+                    {"error": "no such trace"}, status=404)
+            return web.json_response(out, dumps=lambda o: json.dumps(
+                o, default=str))
+
+        async def trace_chrome(request):
+            """The same trace rendered as chrome://tracing events (load
+            in Perfetto / chrome://tracing), one pid per source process."""
+            from ray_tpu.util import tracing
+
+            try:
+                spans = await offload(
+                    _trace_call, "trace_spans",
+                    request.match_info["trace_id"])
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"serve controller unavailable: {e}"},
+                    status=503,
+                )
+            if not spans:
+                return web.json_response(
+                    {"error": "no such trace"}, status=404)
+            return web.json_response(
+                {"traceEvents": tracing.spans_to_chrome(spans)})
+
+        async def slo(request):
+            """Burn-rate state of every declared SLO, with exemplar
+            trace ids for the ones currently burning."""
+
+            def _slo():
+                ctrl = _controller()
+                return ray_tpu.get(ctrl.slo_status.remote(), timeout=30)
+
+            try:
+                out = await offload(_slo)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"serve controller unavailable: {e}"},
+                    status=503,
+                )
+            return web.json_response(out)
+
         loop = asyncio.new_event_loop()
         self._loop = loop
         asyncio.set_event_loop(loop)
@@ -184,6 +267,10 @@ class Dashboard:
         app.router.add_get("/metrics/fleet", fleet_metrics_text)
         app.router.add_get("/api/metrics/fleet", fleet_metrics_json)
         app.router.add_get("/api/metrics/fleet/history", fleet_history)
+        app.router.add_get("/api/traces", traces)
+        app.router.add_get("/api/traces/{trace_id}", trace_get)
+        app.router.add_get("/api/traces/{trace_id}/chrome", trace_chrome)
+        app.router.add_get("/api/slo", slo)
         runner = web.AppRunner(app)
         try:
             loop.run_until_complete(runner.setup())

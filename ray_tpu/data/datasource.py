@@ -6,6 +6,7 @@ from __future__ import annotations
 import glob as globmod
 import math
 import os
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -320,8 +321,13 @@ def read_webdataset(paths, *, decode: bool = True,
         return data
 
     def read(path) -> pa.Table:
-        rows = []
-        current_key, current = None, {}
+        # Group by KEY, not by adjacency: tars written by parallel
+        # producers (or re-packed) can interleave members of different
+        # samples, and adjacency grouping silently yielded duplicate
+        # partial rows per key. First-seen order is preserved; the same
+        # (key, column) member appearing twice is ambiguous data and
+        # raises instead of silently keeping one.
+        samples: "OrderedDict[str, dict]" = OrderedDict()
         with tarfile.open(path) as tf:
             for member in tf:
                 if not member.isfile():
@@ -332,18 +338,21 @@ def read_webdataset(paths, *, decode: bool = True,
                 key, dot, suffix = name.partition(".")
                 if not dot:
                     continue
-                if key != current_key:
-                    if current:
-                        rows.append(current)
-                    current_key, current = key, {"__key__": key}
+                row = samples.get(key)
+                if row is None:
+                    row = samples[key] = {"__key__": key}
                 # a write-side dict/list column lands as "<col>.json" —
                 # restore the original column name after decoding
                 col = suffix[:-5] if suffix.endswith(".json") else suffix
                 if suffixes is not None and col not in suffixes:
                     continue
-                current[col] = _decode(suffix, tf.extractfile(member).read())
-        if current:
-            rows.append(current)
+                if col in row:
+                    raise ValueError(
+                        f"webdataset shard {path!r}: sample {key!r} has "
+                        f"more than one member for column {col!r}"
+                    )
+                row[col] = _decode(suffix, tf.extractfile(member).read())
+        rows = list(samples.values())
         return pa.Table.from_pylist(rows) if rows else pa.table({})
 
     return Dataset([_Read(files, read)])

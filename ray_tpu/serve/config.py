@@ -180,6 +180,11 @@ class HTTPOptions:
     # end-to-end budget for a unary result and the per-chunk budget for
     # streamed responses; None waits forever
     request_timeout_s: float | None = 120.0
+    # head sampling for the fleet trace plane: fraction of UNTAGGED
+    # requests (no x-ray-tpu-trace header) the proxy traces anyway, so
+    # production traffic feeds the TraceStore without client opt-in.
+    # Sampled per request from the proxy's seeded RNG; 0.0 = header-only.
+    trace_sample_rate: float = 0.0
 
 
 @dataclass
@@ -191,3 +196,5 @@ class GrpcOptions:
     host: str = "127.0.0.1"
     port: int = 9000
     request_timeout_s: float | None = 120.0
+    # head sampling, same semantics as HTTPOptions.trace_sample_rate
+    trace_sample_rate: float = 0.0

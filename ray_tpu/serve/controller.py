@@ -37,10 +37,12 @@ from ray_tpu.serve.autoscaling_policy import (
     fleet_saturated,
     shed_classes,
 )
+from ray_tpu.serve import slo as slo_mod
 from ray_tpu.serve.config import DeploymentConfig
 from ray_tpu.serve.llm import obs
 from ray_tpu.serve.replica import ReplicaActor
-from ray_tpu.util import metrics
+from ray_tpu.serve.trace_store import TraceStore
+from ray_tpu.util import metrics, tracing
 
 logger = logging.getLogger("ray_tpu.serve.controller")
 
@@ -64,6 +66,9 @@ _SNAPSHOT_TIMEOUT_S = 30.0
 _FLEET_PERIOD_S = 0.5
 _FLEET_TIMEOUT_S = 30.0
 _FLEET_HISTORY_SAMPLES = 360
+# SLO burn-rate evaluation cadence over the history rings (each tick
+# re-reads whole rings, so it runs a touch slower than the poll)
+_SLO_EVAL_PERIOD_S = 1.0
 # extra actor method threads beyond max_ongoing_requests, so control-plane
 # calls (ping / autoscaling_snapshot / drain_status) never park behind a
 # data plane running at full concurrency — a saturated replica must still
@@ -261,6 +266,42 @@ class ServeController:
             history_samples=_FLEET_HISTORY_SAMPLES
         )
         self._next_self_ingest = 0.0
+        # fleet trace plane (ISSUE 19): spans drained from every polled
+        # process assemble here; bounded + tail-sampled, and (like the
+        # history rings) deliberately NOT checkpointed — a recovered
+        # controller re-collects from live traffic within one poll.
+        self._traces = TraceStore()
+        self._m_spans_ingested = metrics.counter(
+            "serve_trace_spans_ingested_total",
+            "Spans drained from replica/proxy/controller span buffers "
+            "into the fleet TraceStore",
+        )
+        self._m_trace_ingest_errors = metrics.counter(
+            "serve_trace_ingest_errors_total",
+            "Polled span drains the TraceStore failed to ingest "
+            "(malformed report or store error; spans dropped, logged)",
+        )
+        self._m_trace_store = metrics.gauge(
+            "serve_trace_store_traces",
+            "Traces currently resident in the controller's TraceStore",
+        )
+        # SLO burn-rate monitor (serve/slo.py) over the history rings
+        self._slo_specs = tuple(slo_mod.default_slos())
+        self._slo_results: list[dict] = []
+        self._slo_burning: set[str] = set()
+        self._next_slo_eval = 0.0
+        self._m_slo_burn = metrics.gauge(
+            "serve_slo_burn_rate",
+            "Multi-window SLO burn rate (bad_fraction / error budget) "
+            "per SLO and evaluation window",
+            tag_keys=("slo", "window"),
+        )
+        self._m_slo_violations = metrics.counter(
+            "serve_slo_violations_total",
+            "SLO burn alarms raised (every window over its burn "
+            "threshold); counted on the not-burning -> burning edge",
+            tag_keys=("slo",),
+        )
         # crash-recovery checkpointing: _ckpt_io_lock serializes writers
         # (RPC threads + reconciler) so a slow write can't be overtaken
         # by a staler snapshot; _ckpt_dirty marks a failed write for the
@@ -442,6 +483,11 @@ class ServeController:
                 "checkpoint_version": CHECKPOINT_VERSION,
                 "checkpoint_seq": self._ckpt_seq,
             }
+            # reserved like _controller: the SLO monitor's latest verdict
+            out["slo"] = {
+                "burning": sorted(self._slo_burning),
+                "results": list(self._slo_results),
+            }
             return out
 
     def scale_deployment(
@@ -515,6 +561,60 @@ class ServeController:
         forgotten, so series of killed replicas stay queryable — the
         post-mortem counterpart of the live scrape."""
         return self._fleet.history(series=series, prefix=prefix)
+
+    # ---------------- trace plane + SLO RPC surface ----------------
+
+    def trace_list(self, app: str | None = None,
+                   status: str | None = None,
+                   min_duration_s: float | None = None,
+                   limit: int = 100) -> list[dict]:
+        """Summaries of collected traces, newest first (the dashboard's
+        ``/api/traces``). Filterable by app, tail-retention status
+        (error/deadline/shed/preempted/failover/handoff-retry/slow/
+        sampled) and minimum duration."""
+        return self._traces.list_traces(
+            app=app, status=status, min_duration_s=min_duration_s,
+            limit=int(limit),
+        )
+
+    def trace_get(self, trace_id: str) -> dict | None:
+        """One assembled trace tree spanning every collected process
+        (``/api/traces/<id>``); None when the store never saw (or has
+        evicted) the id."""
+        return self._traces.assemble(str(trace_id))
+
+    def trace_spans(self, trace_id: str) -> list[dict] | None:
+        """Flat span list of one trace — the chrome-export input."""
+        return self._traces.spans_of(str(trace_id))
+
+    def trace_store_stats(self) -> dict:
+        return self._traces.stats()
+
+    def trace_push(self, spans: list[dict], source: str = "client") -> int:
+        """Driver-side span delivery. The controller cannot poll the
+        driver (same asymmetry as the router-side shed counters), so
+        clients push their ``tracing.drain_buffered_spans()`` here to
+        join the fleet assembly. Returns the number of spans ingested."""
+        return self._ingest_trace_report(
+            str(source), {"spans": list(spans or ())}, stamp=obs.clock())
+
+    def slo_status(self) -> dict:
+        """Latest burn-rate evaluation (``/api/slo``): every spec's
+        config plus its multi-window result and exemplar trace ids."""
+        return {
+            "specs": [
+                {
+                    "name": s.name, "kind": s.kind,
+                    "objective": s.objective,
+                    "windows_s": list(s.windows_s),
+                    "burn_threshold": s.burn_threshold,
+                    "description": s.description,
+                }
+                for s in self._slo_specs
+            ],
+            "burning": sorted(self._slo_burning),
+            "results": list(self._slo_results),
+        }
 
     def shutdown(self) -> None:
         self._stopped.set()
@@ -592,6 +692,7 @@ class ServeController:
             self._reconcile_proxies(proxy_cfg)
             self._poll_proxy_metrics()
         self._ingest_self_metrics()
+        self._evaluate_slos()
         with self._lock:
             if changed:
                 self._version += 1
@@ -927,6 +1028,21 @@ class ServeController:
             if r in ds.replicas:
                 ds.replicas.remove(r)
             ds.last_error = reason
+        # terminal span flush: a replica that failed its health check can
+        # often still answer one last actor-level drain (a dead ENGINE
+        # leaves the actor alive — the common failover case). Without it,
+        # the kill races the 0.5s poll and the spans of the requests that
+        # died WITH the engine are lost — precisely the traces tail
+        # retention exists to keep. Bounded small so a truly dead process
+        # can't stall the reconcile loop; only the trace buffer is taken
+        # (the metrics families stay last-known in the aggregator).
+        try:
+            rep = ray_tpu.get(r.handle.metrics_report.remote(), timeout=3)
+            self._ingest_trace_report(
+                f"replica:{r.actor_id.hex()[:12]}", rep, stamp=obs.clock()
+            )
+        except Exception:  # noqa: BLE001 — process is gone; its buffered
+            pass           # spans die with it
         try:
             ray_tpu.kill(r.handle)
         except Exception:  # noqa: BLE001
@@ -1012,6 +1128,10 @@ class ServeController:
                             },
                             stamp=now,
                         )
+                        self._ingest_trace_report(
+                            f"replica:{r.actor_id.hex()[:12]}", rep,
+                            stamp=now,
+                        )
                     except Exception:  # noqa: BLE001 — dead/failing
                         pass           # replica; the health check owns it
                     r.metrics_ref = None
@@ -1049,6 +1169,9 @@ class ServeController:
                             },
                             stamp=now,
                         )
+                        self._ingest_trace_report(
+                            f"proxy:{nid.hex()[:12]}", rep, stamp=now,
+                        )
                     except Exception:  # noqa: BLE001 — dead/failing
                         pass           # proxy; its ping path owns it
                     ps.metrics_ref = None
@@ -1077,6 +1200,75 @@ class ServeController:
             {"deployment": "_controller", "replica_id": "controller"},
             stamp=now,
         )
+        # the controller process records spans too (driver-side clients
+        # sharing this process); same drain, same store
+        self._ingest_trace_report(
+            "controller", {"spans": tracing.drain_buffered_spans()},
+            stamp=now,
+        )
+
+    def _ingest_trace_report(self, source: str, rep: dict,
+                             stamp: float) -> int:
+        """Fold one polled report's piggybacked span drain into the
+        TraceStore. Must never raise (it sits on the non-blocking poll
+        path) and must never swallow silently either — failures are
+        counted and logged (sanitizer-lint enforced). Returns the number
+        of spans the store accepted."""
+        spans = rep.get("spans") or ()
+        if not spans:
+            return 0
+        try:
+            n = self._traces.ingest(list(spans), source=source, stamp=stamp)
+            if n:
+                self._m_spans_ingested.inc(n)
+            self._m_trace_store.set(float(len(self._traces)))
+            return n
+        except Exception as e:  # noqa: BLE001 — poll path stays alive
+            self._m_trace_ingest_errors.inc()
+            logger.warning("trace ingest from %s failed: %r", source, e)
+            return 0
+
+    def _evaluate_slos(self) -> None:
+        """Evaluate the declarative SLO specs over the fleet history
+        rings (multi-window burn rates — serve/slo.py), refresh the
+        ``serve_slo_burn_rate`` gauges, count newly-burning violations,
+        and attach exemplar trace ids from the TraceStore — the link
+        from a burning SLO back to the traces that show why."""
+        now = obs.clock()
+        if now < self._next_slo_eval:
+            return
+        self._next_slo_eval = now + _SLO_EVAL_PERIOD_S
+        try:
+            results = slo_mod.evaluate(
+                self._slo_specs, self._fleet.history(), now
+            )
+        except Exception as e:  # noqa: BLE001 — monitor must not kill
+            logger.warning("slo evaluation failed: %r", e)  # the loop
+            return
+        specs = {s.name: s for s in self._slo_specs}
+        burning_now: set[str] = set()
+        for res in results:
+            spec = specs[res["name"]]
+            for wname, w in res["windows"].items():
+                self._m_slo_burn.set(
+                    w["burn_rate"], tags={"slo": res["name"],
+                                          "window": wname},
+                )
+            res["exemplar_trace_ids"] = []
+            if res["burning"]:
+                burning_now.add(res["name"])
+                if res["name"] not in self._slo_burning:
+                    self._m_slo_violations.inc(tags={"slo": res["name"]})
+                if spec.exemplar == "slowest_ttft":
+                    res["exemplar_trace_ids"] = self._traces.exemplar_ids(
+                        slowest_ttft=True)
+                else:
+                    res["exemplar_trace_ids"] = (
+                        self._traces.exemplar_ids(flags=(spec.exemplar,))
+                        or self._traces.exemplar_ids(slowest_ttft=True)
+                    )
+        self._slo_burning = burning_now
+        self._slo_results = results
 
     def _aggregate_signals(self, ds: _DeploymentState) -> list[dict]:
         """Fresh snapshots, one per RUNNING replica (stale or orphaned

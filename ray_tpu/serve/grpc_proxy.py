@@ -43,6 +43,7 @@ from ray_tpu.serve.proxy import (
     PRIORITY_HEADER,
     TRACE_HEADER,
     TRACE_ID_HEADER,
+    head_sampler,
     log_access,
 )
 from ray_tpu.util import tracing
@@ -112,6 +113,10 @@ def _code_for(e: BaseException, priority: str | None = None):
 class GrpcProxy:
     def __init__(self, options):
         self.options = options
+        self._head_sample = head_sampler(
+            f"grpc:{options.host}:{options.port}",
+            getattr(options, "trace_sample_rate", 0.0))
+        self._sample_lock = threading.Lock()  # handlers run on a pool
         self._server = None
         self.port: int | None = None
         # app name -> (ingress deployment, fetched_at)
@@ -152,6 +157,15 @@ class GrpcProxy:
     def _target(self, context) -> tuple[str, str]:
         md = {k: v for k, v in (context.invocation_metadata() or ())}
         return md.get("application", "default"), md.get("method", "__call__")
+
+    def _traced(self, md: dict) -> bool:
+        """Trace when the client opted in via metadata, else head-sample
+        (handlers run on a thread pool, so the shared seeded RNG is
+        guarded by a lock)."""
+        if TRACE_HEADER in md:
+            return True
+        with self._sample_lock:
+            return self._head_sample()
 
     def _dispatch(self, request: bytes, context, state: dict | None = None):
         """-> (response, cancel) where cancel() best-effort cancels the
@@ -211,7 +225,7 @@ class GrpcProxy:
         root = (
             tracing.span("grpc.request", rpc="Call",
                          method=md.get("method", "__call__"))
-            if TRACE_HEADER in md else contextlib.nullcontext({})
+            if self._traced(md) else contextlib.nullcontext({})
         )
         try:
             with root as ctx:
@@ -277,7 +291,7 @@ class GrpcProxy:
             root = (
                 tracing.span("grpc.request", rpc="Stream",
                              method=md.get("method", "__call__"))
-                if TRACE_HEADER in md else contextlib.nullcontext({})
+                if self._traced(md) else contextlib.nullcontext({})
             )
             with root as ctx:
                 if ctx.get("trace_id"):

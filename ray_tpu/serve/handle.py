@@ -208,6 +208,11 @@ class ResumableStreamGenerator:
                if isinstance(payload, dict) else None)
         self._backoff_seed = zlib.crc32(
             str(rid if rid is not None else repr(payload)).encode())
+        # chunks are pulled on pump threads that don't inherit the
+        # caller's contextvars, so the trace context (if any) is captured
+        # HERE — construction happens under the proxy's root span — and
+        # failover spans are recorded from it explicitly
+        self._trace_ctx = tracing.current_context()
 
     def __iter__(self):
         return self
@@ -224,9 +229,14 @@ class ResumableStreamGenerator:
         while True:
             try:
                 if self._inner is None:
-                    self._inner = self._dispatch(
-                        self._payload, frozenset(self._exclude)
-                    )
+                    # re-attach the stored trace context: after a failover
+                    # this runs on a pump thread with no inherited
+                    # contextvars, and the resume dispatch must still
+                    # parent the survivor's spans under the original trace
+                    with tracing.attach_context(self._trace_ctx):
+                        self._inner = self._dispatch(
+                            self._payload, frozenset(self._exclude)
+                        )
                 chunk = next(self._inner)
             except StopIteration:
                 raise
@@ -263,6 +273,25 @@ class ResumableStreamGenerator:
                 aid = getattr(self._inner, "replica_actor_id", None)
                 if aid is not None:
                     self._exclude.add(aid)
+                if self._trace_ctx is not None:
+                    # stitch the failover into the request's trace: the
+                    # resume re-dispatch below opens a fresh dispatch span
+                    # on the surviving replica, and this marker explains
+                    # WHY there are two engine subtrees in one trace
+                    tracing.record_span(
+                        "handle.resume",
+                        trace_id=self._trace_ctx["trace_id"],
+                        parent_span_id=self._trace_ctx["parent_span_id"],
+                        start=time.time(),
+                        end=time.time(),
+                        attrs={
+                            "failover": self.failovers,
+                            "excluded_replica": (aid.hex()[:12]
+                                                 if aid else None),
+                            "delivered_chunks": len(self.chunks),
+                            "cause": type(cause).__name__,
+                        },
+                    )
                 self._payload = self._resume(list(self.chunks))
                 self._inner = None
                 continue
@@ -469,7 +498,8 @@ class _Router:
                 self._decrement(oid)
 
     def _pick_replica(self, deadline: float, exclude: frozenset = frozenset(),
-                      prefix_digests: tuple | None = None):
+                      prefix_digests: tuple | None = None,
+                      route_info: dict | None = None):
         """Prefix-aware placement over power-of-two load balancing.
         ``exclude`` holds actor ids (bytes) of replicas the caller knows
         are dead — the failover path skips them until the controller's
@@ -481,7 +511,11 @@ class _Router:
         chain wins — unless its load skew trips the escape hatch
         (_PREFIX_MAX_SKEW), in which case plain power-of-two resumes.
         Tie-breaking samples from the router's seeded RNG so choice
-        sequences replay deterministically under the chaos harness."""
+        sequences replay deterministically under the chaos harness.
+        ``route_info`` (when given) is filled with the decision the
+        dispatch span reports: strategy, candidate count, prefix match
+        length, and whether the skew escape hatch fired."""
+        info = route_info if route_info is not None else {}
         while True:
             self._refresh()
             with self._lock:
@@ -490,21 +524,26 @@ class _Router:
                     if r._actor_id.binary() not in exclude
                 ]
                 if replicas:
+                    info["candidates"] = len(replicas)
                     if len(replicas) == 1:
+                        info["strategy"] = "single"
                         return replicas[0]
                     if prefix_digests:
+                        info["prefix_blocks"] = len(prefix_digests)
                         choice = self._prefix_choice_locked(
-                            replicas, prefix_digests
+                            replicas, prefix_digests, info
                         )
                         if choice is not None:
                             self._m_prefix_hits.inc(
                                 tags={"app": self.app_name,
                                       "deployment": self.deployment_name}
                             )
+                            info["strategy"] = "prefix"
                             return choice
                     a, b = self._rng.sample(replicas, 2)
                     la = self._inflight.get(a._actor_id.binary(), 0)
                     lb = self._inflight.get(b._actor_id.binary(), 0)
+                    info["strategy"] = "p2c"
                     return a if la <= lb else b
             if time.monotonic() > deadline:
                 raise TimeoutError(
@@ -514,13 +553,16 @@ class _Router:
             time.sleep(0.1)
 
     def _prefix_choice_locked(self, replicas: list,
-                              prefix_digests: tuple):
+                              prefix_digests: tuple,
+                              route_info: dict | None = None):
         """Score each candidate by how many LEADING digests of the
         prompt's chain its advertised summary holds; -> the best replica,
         or None to fall back to power-of-two (no replica matches, or the
         winner is too loaded relative to the least-loaded candidate).
         Ties prefer the less-loaded replica, then table order — fully
         deterministic given one routing table."""
+        if route_info is None:
+            route_info = {}
         best = None
         best_match = 0
         best_load = 0
@@ -542,9 +584,11 @@ class _Router:
                 match == best_match and match > 0 and load < best_load
             ):
                 best, best_match, best_load = r, match, load
+        route_info["matched_blocks"] = best_match
         if best is None or best_match == 0:
             return None
         if best_load - (min_load or 0) > _PREFIX_MAX_SKEW:
+            route_info["skew_escape"] = True
             return None  # escape hatch: hot prefix must not hotspot
         return best
 
@@ -641,10 +685,18 @@ class _Router:
                       "on every replica)" if shed else
                       f"preemption exhausted fleet-wide; class "
                       f"{req_priority!r} is being shed")
-            raise EngineOverloadedError(
-                f"{self.app_name}/{self.deployment_name}: {detail}; "
-                "shedding at admission — retry later"
-            )
+            # traced callers get a shed span (recorded on the exception
+            # exit) so the TraceStore's tail sampler retains the trace
+            with tracing.span_if_active(
+                "handle.shed",
+                deployment=f"{self.app_name}/{self.deployment_name}",
+                priority=req_priority,
+                class_shed=not shed,
+            ):
+                raise EngineOverloadedError(
+                    f"{self.app_name}/{self.deployment_name}: {detail}; "
+                    "shedding at admission — retry later"
+                )
         # prefix-aware placement applies to fresh generation dispatches
         # only: __call__ with a dict payload and no prior_tokens (resumes
         # and control methods keep the plain path — but still compose
@@ -652,17 +704,27 @@ class _Router:
         prefix_digests = None
         if method_name == "__call__" and args and isinstance(args[0], dict):
             prefix_digests = self._prompt_digests(args[0])
+        route_info: dict = {}
         replica = self._pick_replica(
-            time.monotonic() + 30, exclude, prefix_digests
+            time.monotonic() + 30, exclude, prefix_digests, route_info
         )
         aid = replica._actor_id.binary()
         # when the caller carries a trace, open a dispatch span so the
         # replica task (whose trace_ctx is captured at .remote() time)
-        # parents under it; no-op for untraced callers
+        # parents under it; no-op for untraced callers. The routing
+        # decision rides the span: which replica won, by which strategy,
+        # how much of the prompt's prefix it advertised, and whether the
+        # load-skew escape hatch overrode a prefix match.
         dispatch_span = tracing.span_if_active(
             "handle.dispatch",
             deployment=f"{self.app_name}/{self.deployment_name}",
             method=method_name,
+            replica=aid.hex()[:12],
+            strategy=route_info.get("strategy"),
+            candidates=route_info.get("candidates", 0),
+            matched_blocks=route_info.get("matched_blocks", 0),
+            skew_escape=route_info.get("skew_escape", False),
+            excluded=len(exclude),
         )
         if is_stream:
             # generator replica method: dispatch through the streaming

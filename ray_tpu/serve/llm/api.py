@@ -367,29 +367,36 @@ class LLMDeployment:
         # Normal engine path with a 1-token budget: chunked prefill at
         # true positions writes the prompt's KV and registers every full
         # block in the prefix cache; the sampled token is discarded.
+        # Traced callers (this method runs inside the rt_call task span)
+        # get a handoff.seal span covering prefill through store put —
+        # and the engine submit below inherits the active span, so the
+        # PREFILL pool's engine.* spans join the same trace tree.
         sampling = SamplingParams(
             max_new_tokens=1, seed=int(payload.get("seed", 0))
         )
-        stream = self.engine.submit(prompt, sampling)
-        for _ in stream:
-            pass
-        chaos.fire(
-            "llm.handoff.seal",
-            request_id=request_id,
-            attempt=attempt,
-            tag=payload.get("chaos_tag"),
-        )
-        records = self.engine.export_prefix(prompt)
-        if not records:
-            return None
-        wire = kv_transfer.pack_blocks(
-            self.engine.kv_layout(), records,
-            prefix_tokens=len(records) * bs,
-        )
-        oid = kv_transfer.handoff_object_id(request_id, attempt)
-        # pin=False: an orphaned handoff object stays LRU-evictable in
-        # the store even if every sweeper dies
-        worker.put_object(oid, wire, pin=False)
+        with tracing.span_if_active(
+            "handoff.seal", request_id=request_id, attempt=attempt,
+        ):
+            stream = self.engine.submit(prompt, sampling)
+            for _ in stream:
+                pass
+            chaos.fire(
+                "llm.handoff.seal",
+                request_id=request_id,
+                attempt=attempt,
+                tag=payload.get("chaos_tag"),
+            )
+            records = self.engine.export_prefix(prompt)
+            if not records:
+                return None
+            wire = kv_transfer.pack_blocks(
+                self.engine.kv_layout(), records,
+                prefix_tokens=len(records) * bs,
+            )
+            oid = kv_transfer.handoff_object_id(request_id, attempt)
+            # pin=False: an orphaned handoff object stays LRU-evictable in
+            # the store even if every sweeper dies
+            worker.put_object(oid, wire, pin=False)
         self._sealed[oid.hex()] = obs.clock()
         self._handoff_sealed_total += 1
         return {
@@ -441,32 +448,34 @@ class LLMDeployment:
         from ray_tpu.serve.llm import kv_transfer
 
         request_id = manifest.get("request_id") or "?"
+        attempt = int(manifest.get("attempt", 0))
         try:
-            chaos.fire(
-                "llm.handoff.fetch",
-                attempt=int(manifest.get("attempt", 0)),
-                tag=tag,
-            )
-            if global_worker_or_none() is None:
-                raise kv_transfer.KVTransferError(
-                    "no object plane in this process"
+            # traced requests see the decode-side handoff halves as
+            # handoff.fetch / handoff.land spans (attempt-tagged, so a
+            # retried handoff is visibly attempt>0 in the trace tree)
+            with tracing.span_if_active(
+                "handoff.fetch", request_id=request_id, attempt=attempt,
+            ):
+                chaos.fire("llm.handoff.fetch", attempt=attempt, tag=tag)
+                if global_worker_or_none() is None:
+                    raise kv_transfer.KVTransferError(
+                        "no object plane in this process"
+                    )
+                oid = ObjectID.from_hex(str(manifest["object_id"]))
+                wire = ray_tpu.get(
+                    ObjectRef(oid), timeout=_HANDOFF_FETCH_TIMEOUT_S
                 )
-            oid = ObjectID.from_hex(str(manifest["object_id"]))
-            wire = ray_tpu.get(
-                ObjectRef(oid), timeout=_HANDOFF_FETCH_TIMEOUT_S
-            )
-            chaos.fire(
-                "llm.handoff.land",
-                attempt=int(manifest.get("attempt", 0)),
-                tag=tag,
-            )
-            layout, _, records = kv_transfer.unpack_blocks(wire)
-            if layout != self.engine.kv_layout():
-                raise kv_transfer.KVTransferError(
-                    f"layout mismatch: payload {layout} vs engine "
-                    f"{self.engine.kv_layout()}"
-                )
-            landed = self.engine.adopt_prefix(prompt, records)
+            with tracing.span_if_active(
+                "handoff.land", request_id=request_id, attempt=attempt,
+            ):
+                chaos.fire("llm.handoff.land", attempt=attempt, tag=tag)
+                layout, _, records = kv_transfer.unpack_blocks(wire)
+                if layout != self.engine.kv_layout():
+                    raise kv_transfer.KVTransferError(
+                        f"layout mismatch: payload {layout} vs engine "
+                        f"{self.engine.kv_layout()}"
+                    )
+                landed = self.engine.adopt_prefix(prompt, records)
             self._handoff_landed_blocks += landed
             if landed:
                 self._m_handoff_blocks.inc(landed)

@@ -631,6 +631,11 @@ class LLMEngine:
             "llm_deadline_exceeded",
             "Requests evicted because deadline_s expired mid-generation",
         )
+        self._m_finished = metrics.counter(
+            "llm_requests_finished",
+            "Requests that completed generation normally (availability "
+            "SLO denominator)",
+        )
         self._m_hit_tokens = metrics.counter(
             "llm_prefix_hit_tokens",
             "Prompt tokens served from the KV prefix cache (zero compute)",
@@ -1189,6 +1194,12 @@ class LLMEngine:
                     obs.shape_key(s) for s in self.fns.signatures
                 ),
                 "archived_timelines": len(self._timelines),
+                # traced requests currently live in the engine, so an
+                # operator staring at a wedged dump can jump straight to
+                # the matching fleet traces (/api/traces/<id>)
+                "live_trace_ids": self._trace_ids_locked(
+                    list(self._waiting) + self._prefilling
+                    + self._running + self._preempted),
             })
 
     def shutdown(self, dump: bool | str | None = None) -> None:
@@ -1423,7 +1434,8 @@ class LLMEngine:
         resident = r.total_len - 1
         if self.cfg.prefix_caching:
             self.cache.register_prefix(r.id, chain, resident)
-        self.cache.demote_chain(chain, resident)
+        demoted = self.cache.demote_chain(chain, resident,
+                                          trace_ctx=r.trace_ctx)
         self._running.remove(r)
         self._release_blocks_locked(r)
         # back to the pre-admission shape (the resume is a plain
@@ -1446,7 +1458,7 @@ class LLMEngine:
         self._m_preempted_streams.set(len(self._preempted))
         self._m_util.set(self.cache.utilization)
         self._tl(r, "preempted", generated=len(r.generated),
-                 priority=r.sampling.priority)
+                 priority=r.sampling.priority, demoted_blocks=demoted)
         return True
 
     def _maybe_resume_locked(self) -> None:
@@ -1534,6 +1546,7 @@ class LLMEngine:
         req.reserved_blocks = need
         self.cache.allocate(req.id)
         if self.cfg.prefix_caching:
+            promoted0 = self.cache.stats.promoted_blocks
             hit_tokens = self.cache.assign_prefix(
                 req.id, toks, max_blocks=max_hit_blocks
             )
@@ -1542,6 +1555,13 @@ class LLMEngine:
             # chunk) so the engine has logits to sample from
             req.prefill_done = min(hit_tokens, len(toks) - 1)
             req.cached_tokens = req.prefill_done
+            if req.trace_ctx:
+                # host->device promotions staged for THIS admission show
+                # up on the request's trace (span rendered at finish)
+                promoted = self.cache.stats.promoted_blocks - promoted0
+                if promoted:
+                    self._tl(req, "kv_promote", blocks=promoted,
+                             hit_tokens=hit_tokens)
         return True
 
     def _admit_locked(self) -> int:
@@ -1751,6 +1771,7 @@ class LLMEngine:
         self._flight_record_locked(
             kind, t0_wall, dt, batch=len(batch), bucket_b=B, bucket_len=S,
             nb=nb, tokens=int(sum(ns)),
+            trace_ids=self._trace_ids_locked(batch),
         )
 
     def _decode_locked(self) -> None:
@@ -1902,6 +1923,7 @@ class LLMEngine:
         self._flight_record_locked(
             "decode", t0_wall, dt, batch=len(batch), bucket_b=B,
             bucket_len=ctx, nb=nb, tokens=emitted,
+            trace_ids=self._trace_ids_locked(batch),
         )
 
     def _reconcile_locked(self, pending: _PendingDecode) -> int:
@@ -2054,6 +2076,13 @@ class LLMEngine:
             # anyway so a bad verdict can never overrun the budget
             committed = max(1, min(int(packed[i, 0]), dl + 1))
             accepted += committed - 1
+            if r.trace_ctx:
+                # traced rows carry the speculation outcome per window —
+                # rendered as an engine.verify span at finish (host list
+                # append only; untraced rows skip even that)
+                self._tl(r, "verify_window", ts=t0_wall,
+                         dur_ms=round((obs.clock() - t0) * 1000.0, 3),
+                         drafted=dl, accepted=committed - 1, window=W)
             for j in range(committed):
                 self._emit_token_locked(r, int(packed[i, 1 + j]))
                 step_tokens += 1
@@ -2080,6 +2109,7 @@ class LLMEngine:
             "verify", t0_wall, dt, batch=len(batch), bucket_b=B,
             bucket_len=ctx, nb=nb, window=W, drafted=drafted,
             accepted=accepted, tokens=emitted + step_tokens,
+            trace_ids=self._trace_ids_locked(batch),
         )
 
     def _sync_verify_locked(self, packed_dev) -> np.ndarray:
@@ -2351,6 +2381,8 @@ class LLMEngine:
         if r.finish_reason is not None:
             return
         r.finish_reason = reason
+        if reason == "finished":
+            self._m_finished.inc()
         self._tl(r, reason, tokens=len(r.generated))
         self._timelines[r.id] = self._timeline_dict(r)
         while len(self._timelines) > self.cfg.timeline_history:
@@ -2371,6 +2403,8 @@ class LLMEngine:
         events = r.timeline
         start = events[0]["ts"]
         end = events[-1]["ts"]
+        ttft_ts = next(
+            (e["ts"] for e in events if e["event"] == "first_token"), None)
         root = tracing.record_span(
             "engine.request", trace_id=tid,
             parent_span_id=r.trace_ctx.get("parent_span_id"),
@@ -2381,10 +2415,16 @@ class LLMEngine:
                 "prompt_tokens": len(r.prompt),
                 "cached_tokens": r.cached_tokens,
                 "tokens": len(r.generated),
+                "preempt_count": r.preempt_count,
+                "ttft_s": (round(ttft_ts - start, 6)
+                           if ttft_ts is not None else None),
             },
         )
         first_ts = last_ts = None
         decode_tokens = 0
+        preempted_at: dict | None = None
+        verify_windows = drafted = v_accepted = 0
+        v_start = v_end = None
         for e in events:
             ev = e["event"]
             if ev == "admitted":
@@ -2411,12 +2451,68 @@ class LLMEngine:
             elif ev == "token":
                 last_ts = e["ts"]
                 decode_tokens += 1
+            elif ev == "preempted":
+                preempted_at = e
+            elif ev == "resumed" and preempted_at is not None:
+                tracing.record_span(
+                    "engine.preempted", trace_id=tid, parent_span_id=root,
+                    start=preempted_at["ts"], end=e["ts"], kind="engine",
+                    attrs={"parked_ms": e.get("parked_ms"),
+                           "priority": preempted_at.get("priority"),
+                           "demoted_blocks":
+                               preempted_at.get("demoted_blocks"),
+                           "cached_tokens": e.get("cached_tokens")},
+                )
+                preempted_at = None
+            elif ev == "verify_window":
+                # speculation windows aggregate into ONE engine.verify
+                # span (per-window spans would dwarf the decode span)
+                verify_windows += 1
+                drafted += e.get("drafted", 0)
+                v_accepted += e.get("accepted", 0)
+                if v_start is None:
+                    v_start = e["ts"]
+                v_end = e["ts"] + e.get("dur_ms", 0.0) / 1000.0
+            elif ev == "kv_promote":
+                tracing.record_span(
+                    "kv.promote", trace_id=tid, parent_span_id=root,
+                    start=e["ts"], end=e["ts"], kind="kv",
+                    attrs={"blocks": e.get("blocks"),
+                           "hit_tokens": e.get("hit_tokens")},
+                )
+        if preempted_at is not None:
+            # still parked at finish (cancel/shutdown while preempted)
+            tracing.record_span(
+                "engine.preempted", trace_id=tid, parent_span_id=root,
+                start=preempted_at["ts"], end=end, kind="engine",
+                attrs={"priority": preempted_at.get("priority"),
+                       "resumed": False},
+            )
+        if verify_windows:
+            tracing.record_span(
+                "engine.verify", trace_id=tid, parent_span_id=root,
+                start=v_start, end=v_end, kind="engine",
+                attrs={"windows": verify_windows, "drafted": drafted,
+                       "accepted": v_accepted},
+            )
         if first_ts is not None and last_ts > first_ts:
             tracing.record_span(
                 "engine.decode", trace_id=tid, parent_span_id=root,
                 start=first_ts, end=last_ts, kind="engine",
                 attrs={"tokens": decode_tokens},
             )
+
+    def _trace_ids_locked(self, batch) -> list[str]:
+        """Trace ids of the traced requests in a step's batch (bounded),
+        so a flight-recorder post-mortem links a slow step straight to
+        the fleet traces that rode it. Empty for untraced traffic."""
+        out = []
+        for r in batch:
+            if r.trace_ctx:
+                out.append(r.trace_ctx["trace_id"])
+                if len(out) >= 8:
+                    break
+        return out
 
     def _flight_record_locked(self, kind: str, t_wall: float, dt: float,
                               **fields) -> None:
@@ -2451,6 +2547,8 @@ class LLMEngine:
             "promotions": cs.promoted_blocks - self._flight_prev["promote"],
         }
         rec.update(fields)
+        if not rec.get("trace_ids"):
+            rec.pop("trace_ids", None)  # untraced steps stay compact
         if self._last_sync is not None:
             # the step that PAID for a host sync carries its cost + lag
             rec.update(self._last_sync)

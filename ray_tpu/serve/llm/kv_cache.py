@@ -670,7 +670,8 @@ class PagedKVCache:
                 "host-tier demotion of block %d failed: %r", block, exc
             )
 
-    def demote_chain(self, tokens, upto_tokens: int) -> int:
+    def demote_chain(self, tokens, upto_tokens: int,
+                     trace_ctx: dict | None = None) -> int:
         """Proactively back the leading full blocks of ``tokens`` (first
         ``upto_tokens`` of them) into the host tier — the preemption
         pause path (engine._preempt_one_locked): the paused stream's
@@ -681,7 +682,28 @@ class PagedKVCache:
         cache never touches the device itself). Best-effort like every
         demote: a failed capture costs recompute on resume, never
         correctness, so failures are counted + logged, not raised.
-        Returns the number of blocks newly captured."""
+        Returns the number of blocks newly captured.
+
+        ``trace_ctx`` (the paused request's stored trace context) makes
+        the demote visible on the request's trace as a ``kv.demote``
+        span — only traced preemptions pay for the span record."""
+        import time as _time
+
+        t0 = _time.time() if trace_ctx else 0.0
+        captured = self._demote_chain(tokens, upto_tokens)
+        if trace_ctx:
+            from ray_tpu.util import tracing
+
+            tracing.record_span(
+                "kv.demote", trace_id=trace_ctx["trace_id"],
+                parent_span_id=trace_ctx.get("parent_span_id"),
+                start=t0, end=_time.time(), kind="kv",
+                attrs={"blocks": captured,
+                       "upto_tokens": min(upto_tokens, len(tokens))},
+            )
+        return captured
+
+    def _demote_chain(self, tokens, upto_tokens: int) -> int:
         tier = self.host_tier
         if tier is None or self.demote_fn is None:
             return 0

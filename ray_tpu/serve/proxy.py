@@ -13,9 +13,11 @@ import contextlib
 import itertools
 import json
 import logging
+import random
 import threading
 import time
 import uuid
+import zlib
 from typing import Any
 
 import ray_tpu
@@ -48,6 +50,25 @@ PRIORITY_HEADER = "x-ray-tpu-priority"
 # soon as a stream completes), batch backs off hard (it is the first class
 # shed and the last resumed under sustained overload).
 _RETRY_AFTER = {"interactive": "1", "default": "2", "batch": "5"}
+
+
+def head_sampler(seed: str, rate: float):
+    """Head-sampling decision for one proxy: trace ``rate`` of requests
+    that did NOT opt in via the trace header, so production traffic feeds
+    the fleet TraceStore without client cooperation. A closure over a
+    seeded RNG (the repo-wide ``random.Random(zlib.crc32(...))`` pattern —
+    never the process-global ``random.random()``) so the sampled share is
+    deterministic per seed and replayable in tests."""
+    rng = random.Random(zlib.crc32(seed.encode()))
+
+    def sample() -> bool:
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        return rng.random() < rate
+
+    return sample
 
 
 def log_access(proxy: str, path: str, state: dict, *, status: str,
@@ -130,6 +151,8 @@ class _PrefetchedStream:
 class HTTPProxy:
     def __init__(self, options: HTTPOptions):
         self.options = options
+        self._head_sample = head_sampler(
+            f"http:{options.host}:{options.port}", options.trace_sample_rate)
         self.port: int | None = None  # bound port (options.port=0 works)
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
@@ -328,7 +351,7 @@ class HTTPProxy:
             # ingresses the first chunk is ALSO fetched there, so admission
             # and deadline errors map to a status code before the response
             # headers go out; remaining chunks are pumped by stream_response.
-            traced = TRACE_HEADER in request.headers
+            traced = TRACE_HEADER in request.headers or self._head_sample()
             prio_header = request.headers.get(PRIORITY_HEADER)
             state: dict[str, Any] = {"t0": time.perf_counter()}
 

@@ -62,13 +62,15 @@ class ProxyActor:
     def metrics_report(self) -> dict:
         """Fleet-plane snapshot of this proxy process's registry (the
         serve_* ingress counters live here, not in any replica). Same
-        shape as ReplicaActor.metrics_report."""
-        from ray_tpu.util import metrics
+        shape as ReplicaActor.metrics_report (incl. the piggybacked
+        span-buffer drain for the fleet trace plane)."""
+        from ray_tpu.util import metrics, tracing
 
         return {
             "clock": time.perf_counter(),
             "wall": time.time(),
             "families": metrics.collect_families(),
+            "spans": tracing.drain_buffered_spans(),
         }
 
     def stop(self) -> str:

@@ -218,11 +218,16 @@ class ReplicaActor:
         replica process's whole registry as kind-preserving families plus
         a freshness stamp. Same clocks as serve/llm obs — perf_counter
         for the monotonic stamp, wall time for display. Actor-level (not
-        rt_call), so the poll never queues behind user traffic."""
+        rt_call), so the poll never queues behind user traffic. The
+        process's buffered trace spans ride the same payload — one poll
+        feeds both the FleetAggregator and the TraceStore."""
+        from ray_tpu.util import tracing
+
         return {
             "clock": time.perf_counter(),
             "wall": time.time(),
             "families": metrics.collect_families(),
+            "spans": tracing.drain_buffered_spans(),
         }
 
     # -- data surface --

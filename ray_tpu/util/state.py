@@ -35,10 +35,17 @@ def available_resources() -> dict[str, float]:
     return w.gcs.call("cluster_resources")["available"]
 
 
-def _task_events(job_id: str | None = None) -> list[dict]:
+def _task_events(job_id: str | None = None, *,
+                 trace_id: str | None = None,
+                 limit: int | None = None) -> list[dict]:
     w = global_worker()
     w.task_events.flush()
-    return w.gcs.call("list_task_events", {"job_id": job_id})["events"]
+    req: dict = {"job_id": job_id}
+    if trace_id is not None:
+        req["trace_id"] = trace_id
+    if limit is not None:
+        req["limit"] = int(limit)
+    return w.gcs.call("list_task_events", req)["events"]
 
 
 def list_tasks(job_id: str | None = None) -> list[dict]:

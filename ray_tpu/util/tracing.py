@@ -20,7 +20,9 @@ from __future__ import annotations
 
 import contextvars
 import os
+import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Any, Optional
 
@@ -28,6 +30,26 @@ from typing import Any, Optional
 _current: contextvars.ContextVar[Optional[tuple[str, str]]] = (
     contextvars.ContextVar("ray_tpu_span", default=None)
 )
+
+# Process-local span buffer for the fleet trace plane: every recorded
+# span is ALSO kept here (bounded ring — oldest drop first) so the serve
+# controller can drain it through the same non-blocking metrics poll it
+# already runs, without a GCS scan. Deliberately small: a process that is
+# never polled (plain driver scripts) just wraps around.
+_BUFFER_MAX = 2048
+_buffer_lock = threading.Lock()
+_buffer: deque = deque(maxlen=_BUFFER_MAX)
+
+
+def drain_buffered_spans() -> list[dict]:
+    """Atomically take (and clear) this process's buffered spans — the
+    controller-side trace collector calls this via the piggybacked
+    ``metrics_report`` poll. Each entry is a flat span dict:
+    {name, kind, trace_id, span_id, parent_span_id, start, end, attrs}."""
+    with _buffer_lock:
+        out = list(_buffer)
+        _buffer.clear()
+    return out
 
 
 def current_context() -> Optional[dict]:
@@ -40,11 +62,36 @@ def current_context() -> Optional[dict]:
     return {"trace_id": cur[0], "parent_span_id": cur[1]}
 
 
+@contextmanager
+def attach_context(ctx: dict | None):
+    """Re-enter a stored trace context on a thread that did not inherit
+    the submitter's contextvars — stream pump threads, failover resume
+    re-dispatches. Spans opened (and tasks submitted) inside the block
+    parent under ``ctx['parent_span_id']``. No-op for ``None``, so
+    untraced callers can pass their stored context unconditionally."""
+    if not ctx:
+        yield
+        return
+    token = _current.set((ctx["trace_id"], ctx["parent_span_id"]))
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
 def _record(name: str, trace_id: str, span_id: str,
             parent_span_id: str | None, start: float, end: float,
             attrs: dict | None, kind: str) -> None:
     from ray_tpu._private.worker import global_worker_or_none
 
+    # buffer first (works even outside a cluster — unit tests and the
+    # poll-based fleet collection path don't need the GCS at all)
+    with _buffer_lock:
+        _buffer.append({
+            "name": name, "kind": kind, "trace_id": trace_id,
+            "span_id": span_id, "parent_span_id": parent_span_id,
+            "start": start, "end": end, "attrs": attrs or {},
+        })
     w = global_worker_or_none()
     if w is None or getattr(w, "task_events", None) is None:
         return
@@ -141,34 +188,37 @@ def task_span(spec: dict):
                 {"task_id": spec["task_id"].hex()}, kind="task")
 
 
-def get_trace(trace_id: str) -> list[dict]:
-    """All recorded spans of one trace (driver-side, via the GCS)."""
+def get_trace(trace_id: str, limit: int | None = None) -> list[dict]:
+    """All recorded spans of one trace (driver-side, via the GCS).
+
+    The trace-id filter (and the optional ``limit`` cap on returned
+    spans) is applied SERVER-side in the GCS — one trace's cost no
+    longer scales with total task-event volume."""
     from ray_tpu.util.state import _task_events
 
     return [
-        e for e in _task_events()
+        e for e in _task_events(trace_id=trace_id, limit=limit)
         if e.get("event") == "SPAN" and e.get("trace_id") == trace_id
     ]
 
 
-def trace_to_chrome(trace_id: str, filename: str | None = None):
-    """Export one trace as chrome://tracing events (the same consumer as
-    state.timeline())."""
-    import json
-
+def spans_to_chrome(spans: list[dict]) -> list[dict]:
+    """Render a list of span dicts (GCS task events OR the flat buffered
+    shape the fleet TraceStore holds) as chrome://tracing events."""
     events = []
-    for e in sorted(get_trace(trace_id), key=lambda e: e["start"]):
+    for e in sorted(spans, key=lambda e: e["start"]):
         events.append({
             "name": e["name"],
             # the span kind rides the event's task_type slot — the buffer
             # stores it under "type"; accept either key so replayed/legacy
             # events still categorize (regression: tests/test_tracing.py
             # asserts cat == "task" for task-execution spans)
-            "cat": e.get("type") or e.get("task_type") or "span",
+            "cat": (e.get("type") or e.get("task_type")
+                    or e.get("kind") or "span"),
             "ph": "X",
             "ts": e["start"] * 1e6,
             "dur": (e["end"] - e["start"]) * 1e6,
-            "pid": e.get("node_id", "")[:8],
+            "pid": e.get("node_id", e.get("source", ""))[:8],
             "tid": e.get("worker_id", "")[:8],
             "args": {
                 "span_id": e["span_id"],
@@ -176,6 +226,16 @@ def trace_to_chrome(trace_id: str, filename: str | None = None):
                 **(e.get("attrs") or {}),
             },
         })
+    return events
+
+
+def trace_to_chrome(trace_id: str, filename: str | None = None,
+                    limit: int | None = None):
+    """Export one trace as chrome://tracing events (the same consumer as
+    state.timeline())."""
+    import json
+
+    events = spans_to_chrome(get_trace(trace_id, limit=limit))
     if filename is None:
         return events
     with open(filename, "w") as f:

@@ -207,6 +207,53 @@ def test_webdataset_write_read_roundtrip(ray_start, tmp_path):
     assert "cls" not in only_txt[0] and "txt" in only_txt[0]
 
 
+def _write_tar(path, members):
+    import io
+    import tarfile
+
+    with tarfile.open(path, "w") as tar:
+        for name, payload in members:
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+
+
+def test_webdataset_read_groups_interleaved_members(ray_start, tmp_path):
+    """Regression: the wds convention groups members by KEY (basename
+    before the first dot), not by adjacency — a shard whose members
+    interleave across samples (a.txt, b.txt, a.cls, b.cls) must still
+    produce exactly one row per key, in first-seen key order."""
+    from ray_tpu import data
+
+    path = str(tmp_path / "interleaved.tar")
+    _write_tar(path, [
+        ("a.txt", b"caption a"),
+        ("b.txt", b"caption b"),
+        ("a.cls", b"1"),
+        ("b.cls", b"2"),
+    ])
+    rows = data.read_webdataset([path]).take_all()
+    assert [r["__key__"] for r in rows] == ["a", "b"]
+    assert rows[0] == {"__key__": "a", "txt": "caption a", "cls": 1}
+    assert rows[1] == {"__key__": "b", "txt": "caption b", "cls": 2}
+
+
+def test_webdataset_read_rejects_duplicate_member(ray_start, tmp_path):
+    """A shard carrying two members for the same (key, column) is
+    corrupt — silently keeping either one would drop data on the floor,
+    so the read fails loudly naming the key and column."""
+    from ray_tpu import data
+
+    path = str(tmp_path / "dup.tar")
+    _write_tar(path, [
+        ("a.txt", b"first"),
+        ("a.cls", b"1"),
+        ("a.txt", b"second"),
+    ])
+    with pytest.raises(Exception, match="more than one member"):
+        data.read_webdataset([path]).take_all()
+
+
 def test_mongo_write_read_roundtrip(ray_start):
     """pymongo-shaped fake client: client[db][coll] + close(). The
     package isn't in this image, so the datasource's client_factory seam

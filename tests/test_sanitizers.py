@@ -313,6 +313,16 @@ def test_handoff_retry_paths_never_swallow_silently():
         root / "ray_tpu" / "serve" / "controller.py": frozenset({
             "_recover", "_checkpoint", "_adopt_replica",
             "_reap_orphans", "_readopt_proxies",
+            # the trace plane (ISSUE 19): a span drain that fails to
+            # ingest must be counted+logged, or the trace just silently
+            # never assembles and the operator blames the replica
+            "_ingest_trace_report",
+        }),
+        # TraceStore assembly: malformed spans are skipped by shape
+        # check, never by a swallowed exception — any handler added to
+        # these functions later must stay observable
+        root / "ray_tpu" / "serve" / "trace_store.py": frozenset({
+            "ingest", "_classify", "assemble",
         }),
         root / "ray_tpu" / "serve" / "llm" / "structured.py": frozenset({
             "compile_grammar",
@@ -425,7 +435,15 @@ def test_one_clock_in_autoscaling_control_plane():
     The fleet metrics plane (ISSUE 13) rides the same rule: ingest
     stamps order last-write gauges and the history ring, so the polling
     functions must stamp with the controller's obs.clock — a raw clock
-    there would interleave history samples from two timebases."""
+    there would interleave history samples from two timebases.
+
+    The trace plane and SLO monitor (ISSUE 19) extend the scope: the
+    TraceStore orders eviction by ingest stamp and the burn-rate
+    evaluator slices the SAME history rings by window — a raw clock in
+    trace ingest/push, in ``_evaluate_slos``, or anywhere in
+    serve/slo.py or serve/trace_store.py would compare ring stamps
+    against a timebase they were never measured on, shifting every
+    window edge."""
     import ast
     import pathlib
 
@@ -438,6 +456,8 @@ def test_one_clock_in_autoscaling_control_plane():
     recovery_fns = frozenset(
         {"_recover", "_checkpoint", "_build_checkpoint_locked",
          "_adopt_replica"})
+    trace_slo_fns = frozenset(
+        {"_ingest_trace_report", "trace_push", "_evaluate_slos"})
 
     def raw_clock_calls(path, within=None):
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -474,11 +494,14 @@ def test_one_clock_in_autoscaling_control_plane():
     controller = root / "ray_tpu" / "serve" / "controller.py"
     # the scoped functions must exist — a rename would silently un-lint them
     ctrl_src = controller.read_text()
-    for fn in aggregation_fns | recovery_fns:
+    for fn in aggregation_fns | recovery_fns | trace_slo_fns:
         assert f"def {fn}(" in ctrl_src, f"controller lost {fn}()"
     offenders = raw_clock_calls(policy)
     offenders += raw_clock_calls(
-        controller, within=aggregation_fns | recovery_fns)
+        controller, within=aggregation_fns | recovery_fns | trace_slo_fns)
+    offenders += raw_clock_calls(root / "ray_tpu" / "serve" / "slo.py")
+    offenders += raw_clock_calls(
+        root / "ray_tpu" / "serve" / "trace_store.py")
     assert not offenders, (
         f"raw clock reads in the autoscaling control plane: {offenders}"
     )
@@ -649,6 +672,50 @@ def test_metrics_registry_matches_observability_docs():
     assert not ghosts, (
         "docs/OBSERVABILITY.md documents metrics no serve code registers: "
         f"{sorted(ghosts)}"
+    )
+
+
+def test_head_sampling_uses_seeded_rng():
+    """Trace-plane lint (ISSUE 19): head sampling in the ingress proxies
+    must draw from a SEEDED ``random.Random`` instance (the repo-wide
+    ``random.Random(zlib.crc32(seed))`` idiom) — never the process-global
+    module functions. A bare ``random.random()`` makes the sampled share
+    of traffic non-reproducible run to run (and shared global RNG state
+    couples sampling to any other module-level draw in the process), so
+    a trace-dependent test or incident replay can never pin down which
+    requests were sampled. Scope: proxy.py and grpc_proxy.py — any call
+    ``random.<fn>(...)`` on the module object other than the ``Random``
+    constructor (and ``SystemRandom``, which is seeded by the OS and
+    not reproducible — also banned) fails."""
+    import ast
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    proxy = root / "ray_tpu" / "serve" / "proxy.py"
+    grpc_proxy = root / "ray_tpu" / "serve" / "grpc_proxy.py"
+    # the shared sampler factory must exist and be what the gRPC proxy
+    # imports — a rename (or a second ad-hoc sampler) would un-lint it
+    assert "def head_sampler(" in proxy.read_text(), (
+        "proxy.py lost head_sampler()")
+    assert "head_sampler" in grpc_proxy.read_text(), (
+        "grpc_proxy.py no longer uses the shared head_sampler")
+
+    offenders = []
+    for path in (proxy, grpc_proxy):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "random"
+                    and f.attr != "Random"):
+                offenders.append(
+                    f"{path.relative_to(root)}:{node.lineno} "
+                    f"(random.{f.attr})")
+    assert not offenders, (
+        f"unseeded module-global RNG in proxy head sampling: {offenders}"
     )
 
 
