@@ -243,6 +243,13 @@ LOAD_PREEMPTION = {           # aggressive thresholds: CPU tiny-model scale
     "kv_pressure": 0.75, "queue_wait_s": 0.08,
     "resume_pressure": 0.5, "aging_s": 8.0,
 }
+# Quantized-config variant knob: RAY_TPU_LOAD_QUANT="int8"|"fp8" runs the
+# WHOLE load bench (replicas + the unfaulted reference engine) under that
+# quantization, so shedding/drain/failover/preempt-resume are exercised
+# against the quantized pool + weights. Losslessness stays asserted —
+# byte-identity holds WITHIN a config, and every replica shares the
+# config. Unset -> f32 (default bench).
+LOAD_QUANT = os.environ.get("RAY_TPU_LOAD_QUANT", "").strip() or None
 # head-sampling rate for the load window: deterministic per request id
 # (trace_store.sample_decision), so the traced subset is stable across
 # runs. The chaos-tagged stream is ALWAYS traced — its failover trace is
@@ -608,6 +615,28 @@ def run_paged_attn_microbench(
         out[f"{prefix}_{backend}_ms"] = round(
             float(np.percentile(samples, 50)) * 1e3, 3
         )
+    # quantized-KV point: int8 pool with per-(slot, head) scales,
+    # dequantized in-register inside the Pallas kernel. On TPU this is
+    # the bandwidth-bound win (the pool read is 1/4 the bytes); in CPU
+    # interpret mode the number only proves the path — compare against
+    # llm_paged_attn_pallas_ms on real hardware. Key: llm_paged_attn_q8_ms.
+    from ray_tpu.ops.quantization import QuantizedKV, quantize_kv
+
+    kq = QuantizedKV(*quantize_kv(k_layer, "int8"))
+    vq = QuantizedKV(*quantize_kv(v_layer, "int8"))
+    fn = jax.jit(
+        lambda q, k, v, t, p: decode_attention(q, k, v, t, p,
+                                               backend="pallas")
+    )
+    fn(q, kq, vq, tables, positions).block_until_ready()  # compile
+    samples = []
+    for _ in range(PAGED_ATTN_ITERS):
+        t0 = time.perf_counter()
+        fn(q, kq, vq, tables, positions).block_until_ready()
+        samples.append(time.perf_counter() - t0)
+    out[f"{prefix}_q8_ms"] = round(
+        float(np.percentile(samples, 50)) * 1e3, 3
+    )
     return out
 
 
@@ -710,6 +739,26 @@ def run_paged_prefill_microbench(
             out[f"{prefix}{suffix}_{backend}_ms"] = round(
                 float(np.percentile(samples, 50)) * 1e3, 3
             )
+    # quantized-KV prefill point (int8 pool, in-kernel dequant), same
+    # caveat as the decode twin: meaningful on TPU, path-proving in CPU
+    # interpret mode. Key: llm_paged_prefill_q8_ms.
+    from ray_tpu.ops.quantization import QuantizedKV, quantize_kv
+
+    kq = QuantizedKV(*quantize_kv(k_layer, "int8"))
+    vq = QuantizedKV(*quantize_kv(v_layer, "int8"))
+    fn = jax.jit(
+        lambda q, k, v, t, p: prefill_attention(q, k, v, t, p,
+                                                backend="pallas")
+    )
+    fn(q, kq, vq, tables, positions).block_until_ready()  # compile
+    samples = []
+    for _ in range(PAGED_ATTN_ITERS):
+        t0 = time.perf_counter()
+        fn(q, kq, vq, tables, positions).block_until_ready()
+        samples.append(time.perf_counter() - t0)
+    out[f"{prefix}_q8_ms"] = round(
+        float(np.percentile(samples, 50)) * 1e3, 3
+    )
     return out
 
 
@@ -1275,7 +1324,8 @@ def run_load_bench(prefill_replicas: int = 0) -> dict:
     mc = dataclasses.replace(
         LlamaConfig.tiny(), dtype=jnp.float32, attention="xla")
     ecfg = EngineConfig(model="llama", model_config=mc, seed=0,
-                        preemption=dict(LOAD_PREEMPTION))
+                        preemption=dict(LOAD_PREEMPTION),
+                        quantization=LOAD_QUANT)
     rng = np.random.default_rng(LOAD_SEED)
     requests = _load_schedule(rng, mc.vocab_size)
 

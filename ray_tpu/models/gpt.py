@@ -39,6 +39,12 @@ class GPTConfig:
     # TPU and the XLA gather formulation elsewhere. Static in the jitted
     # decode step; threaded from EngineConfig.attention_backend.
     attention_backend: str = "auto"
+    # serving quantization ("int8" | "fp8" | None): weights quantized
+    # per-channel by the executor (ops/quantization.py) and the paged KV
+    # pool stored quantized with per-(token, head) scales. Static in the
+    # jitted steps (part of the decode jit-cache key); threaded from
+    # EngineConfig.quantization. Training paths ignore it.
+    quantization: str | None = None
     remat: bool = False       # jax.checkpoint each block (long-context)
     scan_layers: bool = True  # lax.scan over blocks (one compiled body) vs a
                               # fully unrolled Python loop. Unrolling lets XLA
@@ -135,6 +141,36 @@ def gpt_param_axes(cfg: GPTConfig | None = None) -> dict:
         },
         "ln_f_scale": ("embed",),
         "ln_f_bias": ("embed",),
+    }
+
+
+def gpt_quant_axes(cfg: GPTConfig | None = None) -> dict:
+    """Per-leaf amax reduction axis for serving weight quantization, same
+    tree structure as gpt_init's output (``ops/quantization.py
+    quantize_params``). The axis is each matmul's CONTRACTION axis so the
+    scale is per-output-channel; -1 keeps the leaf in full precision
+    (biases, layer norms — tiny and numerically load-bearing). ``wte``
+    reduces over embed: per-vocab-row scales serve both the gather and
+    the tied lm head (which contracts embed per vocab row)."""
+    return {
+        "wte": 1,
+        "wpe": 1,
+        "blocks": {
+            "ln1_scale": -1,
+            "ln1_bias": -1,
+            "qkv_w": 1,
+            "qkv_b": -1,
+            "proj_w": 1,
+            "proj_b": -1,
+            "ln2_scale": -1,
+            "ln2_bias": -1,
+            "mlp_in_w": 1,
+            "mlp_in_b": -1,
+            "mlp_out_w": 1,
+            "mlp_out_b": -1,
+        },
+        "ln_f_scale": -1,
+        "ln_f_bias": -1,
     }
 
 
@@ -372,7 +408,17 @@ def gpt_prefill(
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
         )
-        if start is None and resolve_backend(cfg.attention_backend) != "pallas":
+        # The fresh-KV shortcut attends over the UNQUANTIZED just-computed
+        # k/v; under a quantized pool it must not run — chunked re-prefill
+        # (failover resume) reads the quantized pool back, and resumed
+        # streams stay byte-identical only if the original prefill saw the
+        # same quantized values. So quantized prefill always attends off
+        # the just-written pool via prefill_attention.
+        if (
+            start is None
+            and cfg.quantization is None
+            and resolve_backend(cfg.attention_backend) != "pallas"
+        ):
             attn = mha_reference(
                 q.transpose(0, 2, 1, 3),
                 kk.transpose(0, 2, 1, 3),

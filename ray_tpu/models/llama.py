@@ -41,6 +41,9 @@ class LlamaConfig:
     # decode attention backend (serve/llm): auto | xla | pallas — see
     # models/gpt.py GPTConfig.attention_backend.
     attention_backend: str = "auto"
+    # serving quantization ("int8" | "fp8" | None) — see models/gpt.py
+    # GPTConfig.quantization. Threaded from EngineConfig.quantization.
+    quantization: str | None = None
     remat: bool = False
     scan_layers: bool = True  # lax.scan over blocks vs unrolled loop (see
                               # models/gpt.py: unrolling dodges the
@@ -153,6 +156,35 @@ def llama_param_axes(cfg: LlamaConfig) -> dict:
         "blocks": blocks,
         "ln_f_scale": ("embed",),
         "lm_head": ("embed", "vocab"),
+    }
+
+
+def llama_quant_axes(cfg: LlamaConfig) -> dict:
+    """Per-leaf amax reduction axis for serving weight quantization (see
+    models/gpt.py gpt_quant_axes): the contraction axis of each matmul so
+    scales are per-output-channel; -1 keeps the leaf in full precision.
+    RMSNorm scales stay f32 (tiny, numerically load-bearing); MoE expert
+    weights stay f32 because ``moe_forward`` consumes the raw params
+    without the ``astype`` dequant seam."""
+    blocks: dict = {
+        "ln1_scale": -1,
+        "wq": 1,
+        "wk": 1,
+        "wv": 1,
+        "wo": 1,
+        "ln2_scale": -1,
+    }
+    if cfg.num_experts:
+        blocks.update(
+            {"moe_router": -1, "moe_w_in": -1, "moe_w_out": -1}
+        )
+    else:
+        blocks.update({"mlp_in": 1, "mlp_out": 1})
+    return {
+        "wte": 1,
+        "blocks": blocks,
+        "ln_f_scale": -1,
+        "lm_head": 0,
     }
 
 
@@ -413,7 +445,14 @@ def llama_prefill(
         k_layer, v_layer = write_kv(
             k_layer, v_layer, kk, vv, pos, block_tables, valid=valid
         )
-        if start is None and resolve_backend(cfg.attention_backend) != "pallas":
+        # see gpt_prefill: the fresh-KV shortcut is gated off under a
+        # quantized pool so prefill attends over the same quantized values
+        # a failover re-prefill would read back.
+        if (
+            start is None
+            and cfg.quantization is None
+            and resolve_backend(cfg.attention_backend) != "pallas"
+        ):
             # mha_reference repeats GQA kv heads internally
             attn = mha_reference(
                 q.transpose(0, 2, 1, 3),

@@ -35,6 +35,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.ops.quantization import QuantizedKV, quantize_kv
+
 NEG_INF = -1e30
 
 
@@ -73,12 +75,30 @@ def write_kv(
     [B, S, H_kv, hd] (prefill: positions [B, S]). `valid` masks rows/tokens
     that are padding — their writes are redirected to the reserved garbage
     block 0, slot 0, keeping the scatter shape-static.
+
+    A ``QuantizedKV`` pool quantizes the incoming values at exactly this
+    scatter's granularity — one amax per (token, kv-head) row — and lands
+    data and scale with the same (blk, slot) indices, so incremental
+    decode appends never touch (or re-quantize) previously written slots.
     """
     block_size = k_layer.shape[1]
     blk, slot = physical_slots(positions, block_tables, block_size)
     if valid is not None:
         blk = jnp.where(valid, blk, 0)
         slot = jnp.where(valid, slot, 0)
+    if isinstance(k_layer, QuantizedKV):
+        kind = "int8" if k_layer.data.dtype == jnp.int8 else "fp8"
+        kq, ks = quantize_kv(k, kind)
+        vq, vs = quantize_kv(v, kind)
+        k_layer = QuantizedKV(
+            k_layer.data.at[blk, slot].set(kq),
+            k_layer.scale.at[blk, slot].set(ks),
+        )
+        v_layer = QuantizedKV(
+            v_layer.data.at[blk, slot].set(vq),
+            v_layer.scale.at[blk, slot].set(vs),
+        )
+        return k_layer, v_layer
     k_layer = k_layer.at[blk, slot].set(k.astype(k_layer.dtype))
     v_layer = v_layer.at[blk, slot].set(v.astype(v_layer.dtype))
     return k_layer, v_layer
@@ -89,9 +109,25 @@ def gather_kv(
 ) -> tuple[jax.Array, jax.Array]:
     """Materialize each sequence's cached context in position order:
     [B, NB * block_size, H_kv, hd]. Unallocated table entries point at the
-    garbage block; the caller masks those positions."""
+    garbage block; the caller masks those positions.
+
+    For a ``QuantizedKV`` pool this is the sanctioned XLA-fallback dequant
+    (f32 out): the gathered context is ONE sequence batch's working set,
+    never the whole pool — the full-pool-dequant lint in
+    tests/test_sanitizers.py allowlists exactly this function and the
+    streaming slab path below."""
     B, NB = block_tables.shape
     _, Bs, H, hd = k_layer.shape
+    if isinstance(k_layer, QuantizedKV):
+        keys = (
+            k_layer.data[block_tables].astype(jnp.float32)
+            * k_layer.scale[block_tables][..., None]
+        ).reshape(B, NB * Bs, H, hd)
+        values = (
+            v_layer.data[block_tables].astype(jnp.float32)
+            * v_layer.scale[block_tables][..., None]
+        ).reshape(B, NB * Bs, H, hd)
+        return keys, values
     keys = k_layer[block_tables].reshape(B, NB * Bs, H, hd)
     values = v_layer[block_tables].reshape(B, NB * Bs, H, hd)
     return keys, values
@@ -130,8 +166,15 @@ def _paged_prefill_streaming(
     def _slab(carry, xs):
         m, l, acc = carry
         i, blk = xs
-        keys = k_layer[blk]      # [B, bs, Hkv, hd]
-        values = v_layer[blk]
+        if isinstance(k_layer, QuantizedKV):
+            # per-slab dequant (one block's worth, in registers/VMEM —
+            # never the whole pool); allowlisted by the dequant lint.
+            kb, vb = k_layer[blk], v_layer[blk]
+            keys = kb.data.astype(jnp.float32) * kb.scale[..., None]
+            values = vb.data.astype(jnp.float32) * vb.scale[..., None]
+        else:
+            keys = k_layer[blk]      # [B, bs, Hkv, hd]
+            values = v_layer[blk]
         s = jnp.einsum(
             "bshgd,bthd->bshgt", qg, keys,
             preferred_element_type=jnp.float32,
@@ -233,11 +276,14 @@ def paged_prefill_attention(
 def _copy_blocks(
     cache_k: jax.Array, cache_v: jax.Array, src: jax.Array, dst: jax.Array
 ) -> tuple[jax.Array, jax.Array]:
-    # cache_k/v: [n_layer, num_blocks, block_size, H_kv, hd]; src/dst: [P].
-    return (
-        cache_k.at[:, dst].set(cache_k[:, src]),
-        cache_v.at[:, dst].set(cache_v[:, src]),
-    )
+    # cache_k/v: [n_layer, num_blocks, block_size, H_kv, hd] (plain pools)
+    # or QuantizedKV pytrees whose scale leaf drops the trailing hd axis;
+    # src/dst: [P]. The tree map moves every leaf — quantized COW clones
+    # data AND scale planes in the same fused op, no dequant round-trip.
+    def _cp(a):
+        return a.at[:, dst].set(a[:, src])
+
+    return jax.tree.map(_cp, cache_k), jax.tree.map(_cp, cache_v)
 
 
 def _land_blocks(
@@ -247,11 +293,18 @@ def _land_blocks(
     k_new: jax.Array,
     v_new: jax.Array,
 ) -> tuple[jax.Array, jax.Array]:
-    # cache_k/v: [n_layer, num_blocks, block_size, H_kv, hd]; blocks: [P];
-    # k_new/v_new: [n_layer, P, block_size, H_kv, hd].
+    # cache_k/v: [n_layer, num_blocks, block_size, H_kv, hd] pools (or
+    # QuantizedKV pytrees); blocks: [P]; k_new/v_new: matching payloads
+    # [n_layer, P, ...] per leaf. Quantized handoffs land the wire's
+    # already-quantized data and scale planes verbatim — bit-exact with
+    # the exporter's pool, which is what keeps disaggregated streams
+    # byte-identical within a quantized config.
+    def _land(a, n):
+        return a.at[:, blocks].set(n.astype(a.dtype))
+
     return (
-        cache_k.at[:, blocks].set(k_new.astype(cache_k.dtype)),
-        cache_v.at[:, blocks].set(v_new.astype(cache_v.dtype)),
+        jax.tree.map(_land, cache_k, k_new),
+        jax.tree.map(_land, cache_v, v_new),
     )
 
 

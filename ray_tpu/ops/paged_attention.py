@@ -70,6 +70,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.ops.attention import LOG2E, NEG_INF
+from ray_tpu.ops.quantization import QuantizedKV
 
 BACKENDS = ("auto", "xla", "pallas")
 
@@ -106,14 +107,19 @@ def _paged_decode_kernel(
     q_ref,       # [1, 1, G, hd] — this (b, kv-head)'s query group, pre-scaled
     k_ref,       # [1, bs, 1, hd] — one physical KV block, one kv head
     v_ref,       # [1, bs, 1, hd]
-    o_ref,       # [1, 1, G, hd]
-    m_scr,       # VMEM [G, 128] f32 running max (lane-broadcast)
-    l_scr,       # VMEM [G, 128] f32 running sum (lane-broadcast)
-    acc_scr,     # VMEM [G, hd] f32 output accumulator
-    *,
+    *rest,       # quantized: (ks_ref, vs_ref, o_ref, scratch...) — the
+                 # [1, bs, 1] per-(slot, head) f32 scale tiles ride the
+                 # same block-table walk as their K/V tiles; else
+                 # (o_ref, scratch...)
     block_size: int,
+    quantized: bool,
 ):
     from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
 
     b = pl.program_id(0)
     i = pl.program_id(2)
@@ -130,9 +136,23 @@ def _paged_decode_kernel(
     # nothing; their (deduped) fetch is skipped and so is their compute.
     @pl.when(i * block_size <= pos)
     def _compute():
-        q = q_ref[0, 0]        # [G, hd], pre-scaled by scale * log2(e)
-        k = k_ref[0, :, 0, :]  # [bs, hd]
-        v = v_ref[0, :, 0, :]
+        if quantized:
+            # in-register dequant: one [bs, hd] tile at a time, scaled by
+            # its [bs] per-(slot, head) factors — the f32 K/V never exist
+            # outside VMEM/registers.
+            q = q_ref[0, 0].astype(jnp.float32)
+            k = (
+                k_ref[0, :, 0, :].astype(jnp.float32)
+                * ks_ref[0, :, 0][:, None]
+            )
+            v = (
+                v_ref[0, :, 0, :].astype(jnp.float32)
+                * vs_ref[0, :, 0][:, None]
+            )
+        else:
+            q = q_ref[0, 0]        # [G, hd], pre-scaled by scale * log2(e)
+            k = k_ref[0, :, 0, :]  # [bs, hd]
+            v = v_ref[0, :, 0, :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -191,8 +211,14 @@ def paged_attention_pallas(
 
     if interpret is None:
         interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    quantized = isinstance(k_layer, QuantizedKV)
+    if quantized:
+        k_data, k_scale = k_layer.data, k_layer.scale
+        v_data, v_scale = v_layer.data, v_layer.scale
+    else:
+        k_data, v_data = k_layer, v_layer
     B, Hq, hd = q.shape
-    _, bs, Hkv, _ = k_layer.shape
+    _, bs, Hkv, _ = k_data.shape
     if Hq % Hkv:
         raise ValueError(
             f"query heads ({Hq}) must be a multiple of KV heads ({Hkv})"
@@ -220,14 +246,30 @@ def paged_attention_pallas(
         )
         return (entry, 0, h, 0)
 
+    def kv_scale_map(b, h, i, tables_ref, pos_ref):
+        # Same walk as kv_map, minus the trailing head_dim coordinate —
+        # a scale tile is fetched iff its K/V tile is.
+        entry = jnp.where(
+            i * bs <= pos_ref[b], tables_ref[b, i], tables_ref[b, 0]
+        )
+        return (entry, 0, h)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, G, hd), q_map),
+        pl.BlockSpec((1, bs, 1, hd), kv_map),
+        pl.BlockSpec((1, bs, 1, hd), kv_map),
+    ]
+    operands = [tables, pos, qf, k_data, v_data]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bs, 1), kv_scale_map),
+            pl.BlockSpec((1, bs, 1), kv_scale_map),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, Hkv, NB),
-        in_specs=[
-            pl.BlockSpec((1, 1, G, hd), q_map),
-            pl.BlockSpec((1, bs, 1, hd), kv_map),
-            pl.BlockSpec((1, bs, 1, hd), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, G, hd), q_map),
         scratch_shapes=[
             pltpu.VMEM((G, 128), jnp.float32),
@@ -236,7 +278,9 @@ def paged_attention_pallas(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, block_size=bs),
+        functools.partial(
+            _paged_decode_kernel, block_size=bs, quantized=quantized
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
         compiler_params=_tpu_compiler_params(
@@ -244,7 +288,7 @@ def paged_attention_pallas(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(tables, pos, qf, k_layer, v_layer)
+    )(*operands)
     return out.reshape(B, Hq, hd)
 
 
@@ -285,16 +329,21 @@ def _paged_prefill_kernel(
     pos_ref,      # [1, qb] int32 — true positions of this q-block's rows
     k_ref,        # [1, bs, 1, hd] — one physical KV block, one kv head
     v_ref,        # [1, bs, 1, hd]
-    o_ref,        # [1, 1, qb*G, hd]
-    m_scr,        # VMEM [qb*G, 128] f32 running max (lane-broadcast)
-    l_scr,        # VMEM [qb*G, 128] f32 running sum (lane-broadcast)
-    acc_scr,      # VMEM [qb*G, hd] f32 output accumulator
-    *,
+    *rest,        # quantized: (ks_ref, vs_ref, o_ref, scratch...) — the
+                  # [1, bs, 1] per-(slot, head) f32 scale tiles ride the
+                  # same frontier-gated block-table walk as K/V; else
+                  # (o_ref, scratch...)
     block_size: int,
     gqa: int,
     window: int | None,
+    quantized: bool,
 ):
     from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
 
     b = pl.program_id(0)
     j = pl.program_id(2)
@@ -320,9 +369,22 @@ def _paged_prefill_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0]        # [qb*G, hd], pre-scaled by scale * log2(e)
-        k = k_ref[0, :, 0, :]  # [bs, hd]
-        v = v_ref[0, :, 0, :]
+        if quantized:
+            # in-register dequant, one [bs, hd] tile at a time (see
+            # _paged_decode_kernel) — no f32 KV tensor in HBM.
+            q = q_ref[0, 0].astype(jnp.float32)
+            k = (
+                k_ref[0, :, 0, :].astype(jnp.float32)
+                * ks_ref[0, :, 0][:, None]
+            )
+            v = (
+                v_ref[0, :, 0, :].astype(jnp.float32)
+                * vs_ref[0, :, 0][:, None]
+            )
+        else:
+            q = q_ref[0, 0]    # [qb*G, hd], pre-scaled by scale * log2(e)
+            k = k_ref[0, :, 0, :]  # [bs, hd]
+            v = v_ref[0, :, 0, :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -411,8 +473,14 @@ def paged_prefill_attention_pallas(
 
     if interpret is None:
         interpret = jax.devices()[0].platform not in ("tpu", "axon")
+    quantized = isinstance(k_layer, QuantizedKV)
+    if quantized:
+        k_data, k_scale = k_layer.data, k_layer.scale
+        v_data, v_scale = v_layer.data, v_layer.scale
+    else:
+        k_data, v_data = k_layer, v_layer
     B, S, Hq, hd = q.shape
-    _, bs, Hkv, _ = k_layer.shape
+    _, bs, Hkv, _ = k_data.shape
     if Hq % Hkv:
         raise ValueError(
             f"query heads ({Hq}) must be a multiple of KV heads ({Hkv})"
@@ -465,15 +533,34 @@ def paged_prefill_attention_pallas(
         entry = jnp.where(needed, tables_ref[b, i], tables_ref[b, 0])
         return (entry, 0, h, 0)
 
+    def kv_scale_map(b, h, j, i, tables_ref, qmax_ref, qmin_ref):
+        # Same frontier-gated walk as kv_map, minus the trailing head_dim
+        # coordinate — a scale tile is fetched iff its K/V tile is.
+        needed = i * bs <= qmax_ref[b, j]
+        if window is not None:
+            needed = jnp.logical_and(
+                needed, (i + 1) * bs > qmin_ref[b, j] - (window - 1)
+            )
+        entry = jnp.where(needed, tables_ref[b, i], tables_ref[b, 0])
+        return (entry, 0, h)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, qb * G, hd), q_map),
+        pl.BlockSpec((1, qb), pos_map),
+        pl.BlockSpec((1, bs, 1, hd), kv_map),
+        pl.BlockSpec((1, bs, 1, hd), kv_map),
+    ]
+    operands = [tables, qmax, qmin, qf, pos, k_data, v_data]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bs, 1), kv_scale_map),
+            pl.BlockSpec((1, bs, 1), kv_scale_map),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, Hkv, nqb, NB),
-        in_specs=[
-            pl.BlockSpec((1, 1, qb * G, hd), q_map),
-            pl.BlockSpec((1, qb), pos_map),
-            pl.BlockSpec((1, bs, 1, hd), kv_map),
-            pl.BlockSpec((1, bs, 1, hd), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, qb * G, hd), q_map),
         scratch_shapes=[
             pltpu.VMEM((qb * G, 128), jnp.float32),
@@ -483,7 +570,8 @@ def paged_prefill_attention_pallas(
     )
     out = pl.pallas_call(
         functools.partial(
-            _paged_prefill_kernel, block_size=bs, gqa=G, window=window
+            _paged_prefill_kernel, block_size=bs, gqa=G, window=window,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, Sp * G, hd), q.dtype),
@@ -494,7 +582,7 @@ def paged_prefill_attention_pallas(
             ),
         ),
         interpret=interpret,
-    )(tables, qmax, qmin, qf, pos, k_layer, v_layer)
+    )(*operands)
     out = out.reshape(B, Hkv, Sp, G, hd).transpose(0, 2, 1, 3, 4)
     return out.reshape(B, Sp, Hq, hd)[:, :S]
 

@@ -1,0 +1,204 @@
+"""Quantized serving primitives: int8 / fp8-e4m3 weights and paged-KV.
+
+Two pytree container types carry (data, scale) pairs through every
+existing seam without changing any call signature:
+
+- ``QuantizedTensor`` — a weight. ``data`` holds the low-precision
+  values, ``scale`` a broadcast-ready per-channel f32 factor (amax over
+  the contraction axis, keepdims). Every serving-path weight use already
+  spells ``params[name].astype(cfg.dtype)``; the ``astype`` method IS
+  the dequant, so the model code is unchanged and XLA fuses the
+  ``data * scale`` expansion into the consuming matmul/gather.
+- ``QuantizedKV`` — one side (k or v) of the paged KV pool. ``data`` is
+  the quantized pool array ``[..., block_size, n_kv_head, head_dim]``
+  and ``scale`` the per-(token-write, kv-head) f32 plane
+  ``data.shape[:-1]`` — scale granularity matches ``write_kv``'s
+  scatter granularity exactly, so incremental decode appends never
+  re-quantize a block and COW/land/demote move scale planes with their
+  data through the same fused ops. Registered as a pytree: ``lax.scan``
+  unstacks the layer axis of data and scale together, jit/device_put/
+  tree.map all flow through, and leading-axis ``__getitem__`` keeps the
+  host-side block plumbing (export / demote / wire stacking) generic.
+
+Quantization GRANULARITY is per-channel / per-(token, head) — one amax
+reduction, symmetric, no zero points: int8 uses s = amax/127 with
+round-half-even, fp8-e4m3 uses s = amax/448 with the dtype's own cast
+rounding. Both are bit-deterministic, which is what keeps chaos
+failover / handoff / demote-promote / preempt-resume byte-identical
+WITHIN a quantized config (the cross-config contract is the agreement
+rate + perplexity gates in tests/test_serve_llm_quant.py, not byte
+identity).
+
+The full-pool dequant lint (tests/test_sanitizers.py) bans
+``astype``-style dequantization of pool arrays outside the Pallas
+kernels and the ``ops/kv_cache.py`` XLA fallback — dequant happens
+per-tile in-register, never as an f32 KV tensor in HBM.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# quantization kind -> (pool/weight dtype, symmetric max representable)
+QUANT_KINDS = ("int8", "fp8")
+_QMAX = {"int8": 127.0, "fp8": 448.0}  # e4m3fn saturates at +-448
+
+
+def resolve_quantization(kind: Any) -> str | None:
+    """Normalize the ``quantization`` knob: None/"" -> None (f32 serving),
+    "int8" | "fp8" pass through. Anything else raises loudly — a typo'd
+    config must never silently serve unquantized."""
+    if kind is None or kind == "":
+        return None
+    if kind not in QUANT_KINDS:
+        raise ValueError(
+            f"quantization must be one of {QUANT_KINDS} or None, "
+            f"got {kind!r}"
+        )
+    return kind
+
+
+def quant_dtype(kind: str):
+    """The storage dtype for a quantization kind (jnp dtype object)."""
+    return jnp.int8 if kind == "int8" else jnp.float8_e4m3fn
+
+
+def quant_max(kind: str) -> float:
+    return _QMAX[kind]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantizedTensor:
+    """A quantized weight: low-precision ``data`` + broadcast-ready
+    per-channel f32 ``scale`` (same rank as data, size-1 on every axis
+    except the channel axis). ``astype`` is the lazy dequant the model
+    code already calls on every serving-path weight use."""
+
+    data: Any
+    scale: Any
+
+    def tree_flatten(self):
+        return (self.data, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def astype(self, dtype):
+        return self.data.astype(dtype) * self.scale.astype(dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantizedKV:
+    """One side of a quantized paged KV pool (or any host/device block
+    slab cut from it): quantized ``data`` plus the f32 ``scale`` plane of
+    shape ``data.shape[:-1]`` (one scale per written (token, kv-head) —
+    the head_dim axis is the amax reduction). Leading-axis indexing
+    slices both leaves, so ``cache.k[:, ids]`` / ``k[:, i]`` host
+    plumbing works unchanged; leaves may be jax OR numpy arrays (the
+    wire/demote paths carry numpy)."""
+
+    data: Any
+    scale: Any
+
+    def tree_flatten(self):
+        return (self.data, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    def __getitem__(self, idx):
+        # valid for leading-axis indexing only (every host-side use):
+        # the trailing head_dim axis exists on data but not on scale.
+        return QuantizedKV(self.data[idx], self.scale[idx])
+
+
+def quantize_kv(x: jax.Array, kind: str) -> tuple[jax.Array, jax.Array]:
+    """Quantize fresh K or V values at write_kv granularity: amax over
+    the trailing head_dim axis -> (data ``x.shape`` in the kind's dtype,
+    scale ``x.shape[:-1]`` f32). Symmetric, deterministic (round-half-
+    even for int8, the e4m3 cast's own rounding for fp8); an all-zero
+    row quantizes to zeros under a unit scale."""
+    qmax = quant_max(kind)
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0.0, amax, 1.0) / qmax
+    scaled = x.astype(jnp.float32) / scale[..., None]
+    scaled = jnp.clip(scaled, -qmax, qmax)
+    if kind == "int8":
+        data = jnp.round(scaled).astype(jnp.int8)
+    else:
+        data = scaled.astype(jnp.float8_e4m3fn)
+    return data, scale
+
+
+def quantize_weight(w: jax.Array, axis: int, kind: str) -> QuantizedTensor:
+    """Per-channel weight quantization: amax over the CONTRACTION axis
+    (keepdims), so the scale attaches to output channels and
+    ``astype``-dequant factorizes exactly through the consuming matmul."""
+    qmax = quant_max(kind)
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax, 1.0) / qmax
+    scaled = jnp.clip(w.astype(jnp.float32) / scale, -qmax, qmax)
+    if kind == "int8":
+        data = jnp.round(scaled).astype(jnp.int8)
+    else:
+        data = scaled.astype(jnp.float8_e4m3fn)
+    return QuantizedTensor(data, scale)
+
+
+def quantize_params(params, axes, kind: str):
+    """Quantize a weight pytree per a same-structure axes tree whose
+    leaves are the per-leaf amax reduction axis, or -1 to keep the leaf
+    in full precision (biases, layer norms, MoE experts, anything a
+    non-``astype`` path consumes)."""
+    kind = resolve_quantization(kind)
+    if kind is None:
+        return params
+
+    def _one(w, axis):
+        if axis is None or axis < 0:
+            return w
+        return quantize_weight(w, int(axis), kind)
+
+    return jax.tree.map(_one, params, axes)
+
+
+def stack_blocks(blocks: list, axis: int = 1):
+    """``np.stack`` generalized over plain arrays and ``QuantizedKV``
+    records — the host-side landing paths (handoff adopt, host-tier
+    promotion drain) stack per-block payloads into one scatter operand
+    and must move scale planes alongside data."""
+    first = blocks[0]
+    if isinstance(first, QuantizedKV):
+        return QuantizedKV(
+            np.stack([b.data for b in blocks], axis=axis),
+            np.stack([b.scale for b in blocks], axis=axis),
+        )
+    return np.stack(blocks, axis=axis)

@@ -56,6 +56,25 @@ def family_param_axes(family: str, model_cfg):
         f"{sorted(FAMILIES)}"
     )
 
+
+def family_quant_axes(family: str, model_cfg):
+    """Per-leaf amax reduction-axis tree matching the family's init
+    output — what the executor feeds ops/quantization.quantize_params
+    when ``model_cfg.quantization`` is set (-1 leaves stay f32). Lives
+    here for the same reason as family_param_axes."""
+    if family == "gpt":
+        from ray_tpu.models.gpt import gpt_quant_axes
+
+        return gpt_quant_axes(model_cfg)
+    if family == "llama":
+        from ray_tpu.models.llama import llama_quant_axes
+
+        return llama_quant_axes(model_cfg)
+    raise ValueError(
+        f"unknown model family {family!r}; expected one of "
+        f"{sorted(FAMILIES)}"
+    )
+
 # Process-wide jit cache: jax.jit memoizes traces per *wrapper*, so two
 # engines over the same (family, config) — e.g. several replicas colocated
 # in one worker, or a test suite constructing many engines — must share

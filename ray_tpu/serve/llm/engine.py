@@ -260,6 +260,19 @@ class EngineConfig:
     # (prefill, prefill_chunk, decode) x buckets, and token streams are
     # byte-identical across backends (tests/test_paged_attention.py).
     attention_backend: str | None = None
+    # ---- quantized serving (ops/quantization.py) ----
+    # None -> f32 weights + f32 paged KV (every prior PR's behavior,
+    # byte-identical). "int8" | "fp8" quantize BOTH the serving weights
+    # (per-channel scales, dequantized lazily at each use site) and the
+    # paged KV pool (per-(token, kv-head) scales, dequantized in-register
+    # inside the Pallas kernels — the pool never materializes f32 in
+    # HBM). STATIC: the knob lands in the frozen model config, so a
+    # quantized engine is one compile-kind set of its own — no
+    # mixed-precision traffic, and streams stay byte-identical WITHIN a
+    # config across failover/handoff/demote-promote/preempt-resume. The
+    # cross-config contract is agreement-rate, not byte-identity
+    # (docs/SERVING_LLM.md "Quantized serving").
+    quantization: str | None = None
     # ---- speculative decoding (drafter.py + executor.verify_step) ----
     # speculative_k > 0 turns on draft-and-verify: a host-side Drafter
     # proposes up to k tokens per sequence and the target model scores
@@ -472,6 +485,22 @@ class LLMEngine:
             model_cfg = dataclasses.replace(
                 model_cfg, attention_backend=backend
             )
+        # thread quantization the same way: EngineConfig wins, else the
+        # model config keeps its own. Validated + normalized here so the
+        # frozen model config carries the canonical spelling — it is part
+        # of the decode.py _jit_cache key, which is exactly what makes a
+        # quantized engine its OWN compile-kind set (never mixed traffic
+        # with an f32 twin).
+        from ray_tpu.ops.quantization import resolve_quantization
+
+        quant = cfg.quantization
+        if quant is None:
+            quant = getattr(model_cfg, "quantization", None)
+        quant = resolve_quantization(quant)
+        if getattr(model_cfg, "quantization", None) != quant:
+            import dataclasses
+
+            model_cfg = dataclasses.replace(model_cfg, quantization=quant)
         self.cfg = cfg
         self.model_cfg = model_cfg
         n_kv = getattr(model_cfg, "n_kv_head", model_cfg.n_head)
@@ -484,6 +513,7 @@ class LLMEngine:
                 block_size=cfg.block_size,
                 dtype=model_cfg.dtype,
                 host_cache_bytes=cfg.host_cache_bytes,
+                quantization=quant,
             )
         )
         # the ModelExecutor seam (executor.py): the engine schedules on
@@ -932,6 +962,7 @@ class LLMEngine:
             n_layer=c.n_layer, block_size=c.block_size,
             n_kv_head=c.n_kv_head, head_dim=c.head_dim,
             dtype=self.cache.k.dtype.name,
+            quantization=getattr(c, "quantization", None),
         )
 
     def export_prefix(self, prompt) -> list:
@@ -995,8 +1026,10 @@ class LLMEngine:
                 vs.append(v_blk)
                 resident += 1
             if ids:
+                from ray_tpu.ops.quantization import stack_blocks
+
                 self.executor.land_blocks(
-                    ids, np.stack(ks, axis=1), np.stack(vs, axis=1)
+                    ids, stack_blocks(ks, axis=1), stack_blocks(vs, axis=1)
                 )
         return resident
 
@@ -1653,11 +1686,13 @@ class LLMEngine:
         if not staged:
             return
         chaos.fire("llm.kv.promote", blocks=len(staged))
+        from ray_tpu.ops.quantization import stack_blocks
+
         ids = [b for b, _, _ in staged]
         self.executor.land_blocks(
             ids,
-            np.stack([k for _, k, _ in staged], axis=1),
-            np.stack([v for _, _, v in staged], axis=1),
+            stack_blocks([k for _, k, _ in staged], axis=1),
+            stack_blocks([v for _, _, v in staged], axis=1),
         )
         self.cache.promotions_landed(ids)
 

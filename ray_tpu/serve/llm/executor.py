@@ -63,7 +63,13 @@ def _host_blocks(kv) -> np.ndarray:
     once per handed-off request on the PREFILL replica — never inside
     the decode scheduler loop — and moves O(blocks) cache bytes, which
     is the whole point of the transfer. Allowlisted by name in
-    tests/test_sanitizers.py next to ``_host_tokens``."""
+    tests/test_sanitizers.py next to ``_host_tokens``. Quantized pools
+    export ``QuantizedKV`` slabs — data and scale planes cross together,
+    still O(blocks) bytes (2-4x fewer of them)."""
+    from ray_tpu.ops.quantization import QuantizedKV
+
+    if isinstance(kv, QuantizedKV):
+        return QuantizedKV(np.asarray(kv.data), np.asarray(kv.scale))
     return np.asarray(kv)
 
 
@@ -104,6 +110,11 @@ class ModelExecutor:
     # set by build_executor from EngineConfig when speculation is on;
     # surfaced via describe() -> stats()/debug_dump()
     speculative: dict | None = None
+    # ShardedExecutor defers weight quantization until after
+    # shard_params (the axes tree must match the RAW param structure,
+    # and quantizing committed sharded arrays lets GSPMD place the
+    # scale shards next to their data).
+    _defer_quantize = False
 
     def __init__(self, family: str, model_cfg, cache, *,
                  params: dict | None = None, seed: int = 0):
@@ -117,6 +128,39 @@ class ModelExecutor:
             params
             if params is not None
             else self.fns.init(jax.random.PRNGKey(seed), model_cfg)
+        )
+        if not self._defer_quantize:
+            self._maybe_quantize_params()
+
+    def _maybe_quantize_params(self) -> None:
+        """Quantize the serving weights per ``model_cfg.quantization``
+        (ops/quantization.quantize_params over the family's quant-axes
+        tree). Init always produces f32 masters — quantization is an
+        executor-build step, so the training paths and the family init
+        functions never see a QuantizedTensor. No-op when the knob is
+        unset or the params are already quantized (pre-built params
+        handed across replicas must not double-quantize)."""
+        kind = getattr(self.model_cfg, "quantization", None)
+        if kind is None:
+            return
+        import jax
+
+        from ray_tpu.ops.quantization import QuantizedTensor, quantize_params
+        from ray_tpu.serve.llm.decode import family_quant_axes
+
+        already = any(
+            isinstance(t, QuantizedTensor)
+            for t in jax.tree.leaves(
+                self.params,
+                is_leaf=lambda t: isinstance(t, QuantizedTensor),
+            )
+        )
+        if already:
+            return
+        self.params = quantize_params(
+            self.params,
+            family_quant_axes(self.family, self.model_cfg),
+            kind,
         )
 
     # ---------------- compile-event hooks (DecodeFns pass-through) ----
@@ -238,10 +282,17 @@ class ModelExecutor:
         makes a host-tier entry demoted under tp=1 byte-identical to one
         demoted under tp=4."""
         if not block_ids:
-            n_layer = self.cache.k.shape[0]
-            shape = (n_layer, 0) + tuple(self.cache.k.shape[2:])
-            empty = np.zeros(shape, np.float32)
-            return empty, empty
+            import jax
+
+            def _empty(a):
+                return np.zeros(
+                    (a.shape[0], 0) + tuple(a.shape[2:]), a.dtype
+                )
+
+            return (
+                jax.tree.map(_empty, self.cache.k),
+                jax.tree.map(_empty, self.cache.v),
+            )
         width = 1 << (len(block_ids) - 1).bit_length()
         ids = np.zeros((width,), np.int32)
         for i, b in enumerate(block_ids):
@@ -266,6 +317,8 @@ class ModelExecutor:
         this one method."""
         if not block_ids:
             return
+        import jax
+
         from ray_tpu.ops.kv_cache import land_blocks
 
         width = 1 << (len(block_ids) - 1).bit_length()
@@ -273,14 +326,18 @@ class ModelExecutor:
         for i, b in enumerate(block_ids):
             ids[i] = b
         if width != len(block_ids):
-            pad = ((0, 0), (0, width - len(block_ids))) + tuple(
-                (0, 0) for _ in range(k_new.ndim - 2)
-            )
-            k_new = np.pad(k_new, pad)
-            v_new = np.pad(v_new, pad)
+
+            def _pad(a):
+                pad = ((0, 0), (0, width - len(block_ids))) + tuple(
+                    (0, 0) for _ in range(a.ndim - 2)
+                )
+                return np.pad(a, pad)
+
+            k_new = jax.tree.map(_pad, k_new)
+            v_new = jax.tree.map(_pad, v_new)
         self.cache.k, self.cache.v = land_blocks(
             self.cache.k, self.cache.v, self._dev(ids),
-            self._dev(k_new), self._dev(v_new),
+            jax.tree.map(self._dev, k_new), jax.tree.map(self._dev, v_new),
         )
 
     def sync_tokens(self, tokens_dev) -> np.ndarray:
@@ -315,12 +372,23 @@ class ModelExecutor:
         from the params pytree's shape metadata (no device sync) — the
         analytic-FLOPs input for serving MFU (2*n_params FLOPs/token,
         forward-only; cf. the training side's 6*n_params in
-        benchmarks/gpt_mfu.py and docs/ROOFLINE.md)."""
+        benchmarks/gpt_mfu.py and docs/ROOFLINE.md). QuantizedTensor
+        leaves count their DATA elements only — the per-channel scale
+        planes are bookkeeping, not model capacity — so MFU and the
+        goodput gauges stay comparable between a quantized engine and
+        its f32 twin."""
         import jax
 
+        from ray_tpu.ops.quantization import QuantizedTensor
+
         if getattr(self, "_num_params", None) is None:
+            leaves = jax.tree_util.tree_leaves(
+                self.params,
+                is_leaf=lambda t: isinstance(t, QuantizedTensor),
+            )
             self._num_params = int(sum(
-                x.size for x in jax.tree_util.tree_leaves(self.params)
+                t.data.size if isinstance(t, QuantizedTensor) else t.size
+                for t in leaves
             ))
         return self._num_params
 
@@ -367,6 +435,8 @@ class ModelExecutor:
         return {"executor": self.kind, "devices": self.num_devices,
                 "mesh": None,
                 "attention_backend": self.attention_backend,
+                "quantization": getattr(
+                    self.model_cfg, "quantization", None),
                 "speculative": self.speculative}
 
 
@@ -444,6 +514,7 @@ class ShardedExecutor(ModelExecutor):
     dp/sp/pp/ep serving is future roadmap, not silently wrong."""
 
     kind = "sharded"
+    _defer_quantize = True  # quantize after shard_params (see base attr)
 
     def __init__(self, family: str, model_cfg, cache, *,
                  mesh=None, tp: int = 1, fsdp: int = 1,
@@ -478,9 +549,19 @@ class ShardedExecutor(ModelExecutor):
             self.params, family_param_axes(family, model_cfg),
             self.mesh, self.rules,
         )
+        # Quantization runs AFTER shard_params: the axes tree matches the
+        # raw param structure, and quantizing committed sharded arrays
+        # lets GSPMD keep each scale shard colocated with its data shard
+        # (the amax reduction is over an axis, so the result is the same
+        # on any mesh).
+        self._maybe_quantize_params()
+        # The KV-head axis (axis 3) is the tp shard axis for the 5-d data
+        # plane AND the 4-d scale plane of a quantized pool — one spec
+        # serves both leaves.
         kv_spec = PartitionSpec(None, None, None, AxisNames.TENSOR)
-        cache.k = jax.device_put(cache.k, NamedSharding(self.mesh, kv_spec))
-        cache.v = jax.device_put(cache.v, NamedSharding(self.mesh, kv_spec))
+        sh = NamedSharding(self.mesh, kv_spec)
+        cache.k = jax.tree.map(lambda a: jax.device_put(a, sh), cache.k)
+        cache.v = jax.tree.map(lambda a: jax.device_put(a, sh), cache.v)
 
     @property
     def num_devices(self) -> int:
@@ -498,6 +579,7 @@ class ShardedExecutor(ModelExecutor):
             "mesh": {a: int(s) for a, s in self.mesh.shape.items()
                      if int(s) > 1},
             "attention_backend": self.attention_backend,
+            "quantization": getattr(self.model_cfg, "quantization", None),
             "speculative": self.speculative,
         }
 

@@ -90,8 +90,15 @@ class KVCacheConfig:
     # Host-memory cache tier capacity. 0 disables the tier: LRU eviction
     # discards content exactly as before. When > 0, evicted prefix blocks
     # demote into a host arena of at most this many bytes (RTKV wire
-    # size, so header + digests count against the cap).
+    # size, so header + digests count against the cap — and a quantized
+    # pool's 2-4x smaller records buy proportionally more entries).
     host_cache_bytes: int = 0
+    # "int8" | "fp8" | None: store the pool quantized with per-(token,
+    # head) scale planes (ops/quantization.QuantizedKV). Static — set
+    # once at engine build (EngineConfig.quantization); dtype is then
+    # the scale/compute reference dtype and the pool data dtype comes
+    # from the kind.
+    quantization: str | None = None
 
     @property
     def usable_blocks(self) -> int:
@@ -188,7 +195,9 @@ class HostKVTier:
         from ray_tpu.serve.llm import kv_transfer
 
         wire = self._wire[digest]
-        _, _, records = kv_transfer.unpack_blocks(wire)
+        # expect= turns a layout/quantization mismatch into a loud,
+        # field-naming error instead of an opaque digest failure.
+        _, _, records = kv_transfer.unpack_blocks(wire, expect=self.layout)
         chain, k_block, v_block = records[0]
         if chain != digest:
             raise kv_transfer.KVTransferError(
@@ -223,8 +232,27 @@ class PagedKVCache:
             cfg.n_layer, cfg.num_blocks, cfg.block_size,
             cfg.n_kv_head, cfg.head_dim,
         )
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        if cfg.quantization is not None:
+            from ray_tpu.ops.quantization import (
+                QuantizedKV,
+                quant_dtype,
+                resolve_quantization,
+            )
+
+            kind = resolve_quantization(cfg.quantization)
+            qdt = quant_dtype(kind)
+            # data in the kind's storage dtype + per-(slot, head) f32
+            # scale planes — write_kv quantizes at exactly this
+            # granularity, so appends never re-quantize a block.
+            self.k = QuantizedKV(
+                jnp.zeros(shape, qdt), jnp.zeros(shape[:-1], jnp.float32)
+            )
+            self.v = QuantizedKV(
+                jnp.zeros(shape, qdt), jnp.zeros(shape[:-1], jnp.float32)
+            )
+        else:
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
         # LIFO free list: a just-freed (cache-warm) block is reused first
         self._free: list[int] = list(range(1, cfg.num_blocks))
         # Lag-aware release (dispatch-ahead decode): blocks freed while a
@@ -263,6 +291,7 @@ class PagedKVCache:
                     n_kv_head=cfg.n_kv_head,
                     head_dim=cfg.head_dim,
                     dtype=self.k.dtype.name,
+                    quantization=cfg.quantization,
                 ),
             )
         else:

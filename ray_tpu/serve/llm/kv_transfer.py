@@ -14,15 +14,27 @@ Wire layout (all integers little-endian):
     HLEN    u32       length of the JSON header that follows
     HEADER  HLEN      json: {n_layer, block_size, n_kv_head, head_dim,
                              dtype, num_blocks, prefix_tokens}
+                      v2 (quantized pools) adds: {quantization,
+                             scale_dtype} — dtype is then the QUANTIZED
+                             storage dtype (int8 / float8_e4m3fn)
     then, per block, in chain order:
       CHAIN   16 bytes  blake2b-16 token-chain digest (PR-3 prefix
                         machinery) — lets the decode side verify the
                         block corresponds to ITS tokenization of the
                         prompt before adopting it
-      CONTENT 16 bytes  blake2b-16 over the raw k||v payload bytes —
+      CONTENT 16 bytes  blake2b-16 over the raw payload bytes below —
                         catches corruption/truncation in transit
-      K       n_layer*block_size*n_kv_head*head_dim * itemsize bytes
-      V       same size
+      v1:  K || V       each n_layer*block_size*n_kv_head*head_dim
+                        * itemsize bytes
+      v2:  K || KS || V || VS   quantized data planes plus their
+                        [n_layer, block_size, n_kv_head] scale planes
+
+f32 pools keep emitting byte-for-byte v1 payloads; quantized pools emit
+v2 (a 2-4x smaller record — the scale plane is 1/head_dim the size of
+the f32 savings). ``unpack_blocks`` reads both, and an ``expect=``
+layout turns any config mismatch (dtype, quantization kind, geometry)
+into a loud, named :class:`KVTransferError` instead of the opaque
+digest failure a silent reinterpret would produce downstream.
 
 Integrity is layered: the header pins the tensor layout (a mismatched
 mesh/model simply refuses the handoff), the chain digest pins *which
@@ -48,7 +60,8 @@ import numpy as np
 from ray_tpu._private.ids import ObjectID
 
 MAGIC = b"RTKV"
-WIRE_VERSION = 1
+WIRE_VERSION = 1        # f32 pools
+WIRE_VERSION_QUANT = 2  # quantized pools (adds scale planes + header fields)
 _DIGEST = 16  # blake2b digest_size, matches kv_cache._block_key
 _HDR = struct.Struct("<4sHI")
 
@@ -72,11 +85,30 @@ class KVLayout:
     n_kv_head: int
     head_dim: int
     dtype: str
+    # quantized pools: the kind ("int8" | "fp8") and the scale plane's
+    # dtype. None => v1 f32/bf16 payloads, byte-identical to pre-v2 wire.
+    quantization: str | None = None
+    scale_dtype: str = "float32"
 
     @property
     def block_bytes(self) -> int:
+        """One side's DATA bytes per block (in ``dtype`` — the quantized
+        storage dtype for v2 layouts)."""
         n = self.n_layer * self.block_size * self.n_kv_head * self.head_dim
         return n * np.dtype(_resolve_dtype(self.dtype)).itemsize
+
+    @property
+    def scale_bytes(self) -> int:
+        """One side's scale-plane bytes per block (0 for f32 pools)."""
+        if self.quantization is None:
+            return 0
+        n = self.n_layer * self.block_size * self.n_kv_head
+        return n * np.dtype(_resolve_dtype(self.scale_dtype)).itemsize
+
+    @property
+    def record_payload_bytes(self) -> int:
+        """K + V (+ scale planes) bytes per block record on the wire."""
+        return 2 * (self.block_bytes + self.scale_bytes)
 
 
 def _resolve_dtype(name: str):
@@ -105,6 +137,25 @@ def handoff_object_id(request_id: str, attempt: int) -> ObjectID:
     return ObjectID(h.digest())
 
 
+def _record_payload(layout: KVLayout, k_block, v_block) -> bytes:
+    """Serialize one block record's payload per the layout's version:
+    v1 is K||V; v2 (quantized) is K||KS||V||VS with the scale planes
+    packed beside their data so landing is a verbatim scatter."""
+    if layout.quantization is None:
+        return k_block.tobytes() + v_block.tobytes()
+    from ray_tpu.ops.quantization import QuantizedKV
+
+    if not isinstance(k_block, QuantizedKV):
+        raise KVTransferError(
+            f"layout says quantization={layout.quantization!r} but the "
+            f"block payload is a plain {type(k_block).__name__}"
+        )
+    return (
+        k_block.data.tobytes() + k_block.scale.tobytes()
+        + v_block.data.tobytes() + v_block.scale.tobytes()
+    )
+
+
 def pack_blocks(
     layout: KVLayout,
     records: list[tuple[bytes, np.ndarray, np.ndarray]],
@@ -113,7 +164,9 @@ def pack_blocks(
 ) -> bytes:
     """Pack ``records`` — (chain_digest, k_block, v_block) in chain
     order — into one wire payload.  Each k/v block has shape
-    [n_layer, block_size, n_kv_head, head_dim]."""
+    [n_layer, block_size, n_kv_head, head_dim]; for a quantized layout
+    the blocks are ``QuantizedKV`` records whose scale planes drop the
+    trailing head_dim axis, and the wire is version 2."""
     header = {
         "n_layer": layout.n_layer,
         "block_size": layout.block_size,
@@ -123,19 +176,24 @@ def pack_blocks(
         "num_blocks": len(records),
         "prefix_tokens": prefix_tokens,
     }
+    version = WIRE_VERSION
+    if layout.quantization is not None:
+        version = WIRE_VERSION_QUANT
+        header["quantization"] = layout.quantization
+        header["scale_dtype"] = layout.scale_dtype
     hjson = json.dumps(header, sort_keys=True).encode()
-    parts = [_HDR.pack(MAGIC, WIRE_VERSION, len(hjson)), hjson]
+    parts = [_HDR.pack(MAGIC, version, len(hjson)), hjson]
     for chain_digest, k_block, v_block in records:
         if len(chain_digest) != _DIGEST:
             raise KVTransferError(
                 f"chain digest must be {_DIGEST} bytes, got "
                 f"{len(chain_digest)}"
             )
-        payload = k_block.tobytes() + v_block.tobytes()
-        if len(payload) != 2 * layout.block_bytes:
+        payload = _record_payload(layout, k_block, v_block)
+        if len(payload) != layout.record_payload_bytes:
             raise KVTransferError(
                 f"block payload is {len(payload)} bytes, layout says "
-                f"{2 * layout.block_bytes}"
+                f"{layout.record_payload_bytes}"
             )
         content = hashlib.blake2b(payload, digest_size=_DIGEST).digest()
         parts.append(chain_digest)
@@ -144,13 +202,39 @@ def pack_blocks(
     return b"".join(parts)
 
 
+def _check_layout_match(layout: KVLayout, expect: KVLayout) -> None:
+    """Raise a :class:`KVTransferError` NAMING every field on which a
+    payload's layout disagrees with the pool that would land it. Without
+    this, a dtype or quantization-kind mismatch reinterprets bytes and
+    surfaces far away as an opaque digest/shape failure."""
+    if layout == expect:
+        return
+    diffs = []
+    for f in (
+        "n_layer", "block_size", "n_kv_head", "head_dim", "dtype",
+        "quantization", "scale_dtype",
+    ):
+        got, want = getattr(layout, f), getattr(expect, f)
+        if got != want:
+            diffs.append(f"{f}: payload={got!r} pool={want!r}")
+    raise KVTransferError(
+        "KV payload layout does not match this pool ("
+        + "; ".join(diffs) + ")"
+    )
+
+
 def unpack_blocks(
     wire: bytes,
+    *,
+    expect: KVLayout | None = None,
 ) -> tuple[KVLayout, int, list[tuple[bytes, np.ndarray, np.ndarray]]]:
-    """Parse and verify a wire payload.
+    """Parse and verify a wire payload (versions 1 and 2).
 
     Returns (layout, prefix_tokens, records) where records are
-    (chain_digest, k_block, v_block) in chain order.  Raises
+    (chain_digest, k_block, v_block) in chain order — plain arrays for
+    v1, ``QuantizedKV`` (numpy leaves) for v2.  ``expect`` (the landing
+    pool's layout) turns any config mismatch into a loud, field-naming
+    error BEFORE bytes are reinterpreted.  Raises
     :class:`KVTransferError` on any structural or digest mismatch —
     the caller falls back to local prefill.
     """
@@ -159,9 +243,10 @@ def unpack_blocks(
     magic, version, hlen = _HDR.unpack_from(wire, 0)
     if magic != MAGIC:
         raise KVTransferError(f"bad magic {magic!r}")
-    if version != WIRE_VERSION:
+    if version not in (WIRE_VERSION, WIRE_VERSION_QUANT):
         raise KVTransferError(
-            f"wire version {version} != supported {WIRE_VERSION}"
+            f"wire version {version} not in supported "
+            f"{(WIRE_VERSION, WIRE_VERSION_QUANT)}"
         )
     off = _HDR.size
     if len(wire) < off + hlen:
@@ -171,6 +256,10 @@ def unpack_blocks(
     except ValueError as e:
         raise KVTransferError(f"undecodable header: {e}") from e
     off += hlen
+    if version == WIRE_VERSION and "quantization" in header:
+        raise KVTransferError("v1 payload carries quantization fields")
+    if version == WIRE_VERSION_QUANT and "quantization" not in header:
+        raise KVTransferError("v2 payload missing quantization fields")
     try:
         layout = KVLayout(
             n_layer=int(header["n_layer"]),
@@ -178,13 +267,24 @@ def unpack_blocks(
             n_kv_head=int(header["n_kv_head"]),
             head_dim=int(header["head_dim"]),
             dtype=str(header["dtype"]),
+            quantization=(
+                str(header["quantization"])
+                if version == WIRE_VERSION_QUANT else None
+            ),
+            scale_dtype=(
+                str(header.get("scale_dtype", "float32"))
+                if version == WIRE_VERSION_QUANT else "float32"
+            ),
         )
         num_blocks = int(header["num_blocks"])
         prefix_tokens = int(header["prefix_tokens"])
     except (KeyError, ValueError) as e:
         raise KVTransferError(f"malformed header: {e}") from e
+    if expect is not None:
+        _check_layout_match(layout, expect)
     block_bytes = layout.block_bytes
-    rec_size = 2 * _DIGEST + 2 * block_bytes
+    scale_bytes = layout.scale_bytes
+    rec_size = 2 * _DIGEST + layout.record_payload_bytes
     if len(wire) != off + num_blocks * rec_size:
         raise KVTransferError(
             f"payload size {len(wire)} != expected "
@@ -199,12 +299,34 @@ def unpack_blocks(
         off += _DIGEST
         content = wire[off:off + _DIGEST]
         off += _DIGEST
-        payload = wire[off:off + 2 * block_bytes]
-        off += 2 * block_bytes
+        payload = wire[off:off + layout.record_payload_bytes]
+        off += layout.record_payload_bytes
         got = hashlib.blake2b(payload, digest_size=_DIGEST).digest()
         if got != content:
             raise KVTransferError(f"content digest mismatch on block {i}")
-        k = np.frombuffer(payload[:block_bytes], dtype=dtype).reshape(shape)
-        v = np.frombuffer(payload[block_bytes:], dtype=dtype).reshape(shape)
+        if layout.quantization is None:
+            k = np.frombuffer(
+                payload[:block_bytes], dtype=dtype
+            ).reshape(shape)
+            v = np.frombuffer(
+                payload[block_bytes:], dtype=dtype
+            ).reshape(shape)
+        else:
+            from ray_tpu.ops.quantization import QuantizedKV
+
+            sdtype = _resolve_dtype(layout.scale_dtype)
+            side = block_bytes + scale_bytes
+            kb, vb = payload[:side], payload[side:]
+
+            def _side(buf):
+                data = np.frombuffer(
+                    buf[:block_bytes], dtype=dtype
+                ).reshape(shape)
+                scale = np.frombuffer(
+                    buf[block_bytes:], dtype=sdtype
+                ).reshape(shape[:-1])
+                return QuantizedKV(data, scale)
+
+            k, v = _side(kb), _side(vb)
         records.append((chain, k, v))
     return layout, prefix_tokens, records

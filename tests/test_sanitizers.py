@@ -331,6 +331,19 @@ def test_handoff_retry_paths_never_swallow_silently():
             "_advance_fsm_locked", "_preempt_one_locked",
             "_maybe_resume_locked",
         }),
+        # Quantized serving (ISSUE 20): the wire-format validation paths
+        # must fail LOUD. A swallowed layout mismatch in unpack would
+        # land int8 bytes into an f32 pool (or vice versa) and the
+        # stream would keep decoding garbage; same for the quantization
+        # knob itself — a typo'd kind must refuse the engine, never
+        # silently fall back to f32. Name-pinning these functions also
+        # guards against a rename un-linting them.
+        root / "ray_tpu" / "serve" / "llm" / "kv_transfer.py": frozenset({
+            "unpack_blocks", "_check_layout_match", "_record_payload",
+        }),
+        root / "ray_tpu" / "ops" / "quantization.py": frozenset({
+            "resolve_quantization",
+        }),
     }
     offenders = []
     for path, fns in scopes.items():
@@ -596,6 +609,96 @@ def test_decode_attention_path_never_materializes_kv():
     )
     assert not offenders, (
         f"materializing ops in the paged attention paths: {offenders}"
+    )
+
+
+def test_no_full_pool_dequant_outside_attention_kernels():
+    """Quantized-serving lint (ISSUE 20): a quantized KV pool must be
+    dequantized IN-REGISTER inside the attention paths — the Pallas
+    kernels (ops/paged_attention.py, excluded from this lint: in-kernel
+    dequant is the point) and the two sanctioned XLA fallbacks in
+    ops/kv_cache.py (``gather_kv``, the dense formulation's legitimate
+    core, and ``_paged_prefill_streaming``'s per-slab dequant). An
+    ``astype``/``convert_element_type`` applied to a pool reference
+    anywhere else materializes an f32 copy of cache bytes in HBM —
+    silently giving back the 2-4x capacity and bandwidth win the
+    quantized pool exists for. Scope: all of serve/llm, both LLM model
+    families, and ops/kv_cache.py outside its allowlisted functions.
+    Pool references are receivers that mention the pool parameter names
+    (``cache_k``/``cache_v``/``k_layer``/``v_layer``) or a ``.k``/``.v``
+    attribute of a cache-like object (``self.cache.k`` etc.)."""
+    import ast
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    pool_names = {"cache_k", "cache_v", "k_layer", "v_layer"}
+    allowed = {
+        ("kv_cache.py", "gather_kv"),
+        ("kv_cache.py", "_paged_prefill_streaming"),
+    }
+    targets = sorted((root / "ray_tpu" / "serve" / "llm").rglob("*.py"))
+    targets += [
+        root / "ray_tpu" / "models" / "gpt.py",
+        root / "ray_tpu" / "models" / "llama.py",
+        root / "ray_tpu" / "ops" / "kv_cache.py",
+    ]
+    # the sanctioned fallbacks must exist under their allowlisted names —
+    # a rename would silently re-scope the lint
+    kv_src = (root / "ray_tpu" / "ops" / "kv_cache.py").read_text()
+    for _, fn in allowed:
+        assert f"def {fn}(" in kv_src, f"ops/kv_cache.py lost {fn}()"
+
+    def mentions_pool(node) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in pool_names:
+                return True
+            if (isinstance(sub, ast.Attribute) and sub.attr in ("k", "v")
+                    and isinstance(sub.value, ast.Attribute)
+                    and "cache" in sub.value.attr):
+                return True
+            if (isinstance(sub, ast.Attribute) and sub.attr in ("k", "v")
+                    and isinstance(sub.value, ast.Name)
+                    and "cache" in sub.value.id):
+                return True
+        return False
+
+    offenders = []
+    for path in targets:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        parents: dict[ast.AST, str] = {}
+
+        def tag(node, fn):
+            for child in ast.iter_child_nodes(node):
+                name = fn
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    name = child.name
+                parents[child] = name
+                tag(child, name)
+
+        tag(tree, "<module>")
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            dequant_like = False
+            if isinstance(f, ast.Attribute) and f.attr == "astype":
+                dequant_like = mentions_pool(f.value)
+            elif ((isinstance(f, ast.Attribute)
+                   and f.attr == "convert_element_type")
+                  or (isinstance(f, ast.Name)
+                      and f.id == "convert_element_type")):
+                dequant_like = any(mentions_pool(a) for a in node.args)
+            if not dequant_like:
+                continue
+            fn = parents.get(node, "<module>")
+            if (path.name, fn) in allowed:
+                continue
+            offenders.append(f"{path.relative_to(root)}:{node.lineno} ({fn})")
+    assert not offenders, (
+        "full-pool dequantization outside the attention kernels "
+        f"(materializes f32 cache bytes in HBM): {offenders}"
     )
 
 
